@@ -1,0 +1,14 @@
+"""dist-keras-tpu: TPU-native distributed training framework.
+
+A ground-up JAX/XLA re-design of the capabilities of dist-keras
+(feihugis/dist-keras, fork of cerndb/dist-keras): the same trainer /
+transformer / predictor / evaluator API surface, with Spark + socket
+parameter servers replaced by SPMD collectives over a TPU mesh (sync path)
+and a host-side asynchronous parameter server (async-parity path).
+"""
+
+__version__ = "0.1.0"
+
+from . import data, models, ops, utils
+from .data import Dataset
+from .models import Model, Sequential

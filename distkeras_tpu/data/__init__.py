@@ -1,0 +1,5 @@
+from .dataset import Dataset
+from .transformers import (
+    Transformer, OneHotTransformer, MinMaxTransformer, ReshapeTransformer,
+    DenseTransformer, LabelIndexTransformer,
+)

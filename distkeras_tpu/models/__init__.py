@@ -1,0 +1,6 @@
+from .layers import (
+    Layer, Dense, Activation, Flatten, Reshape, Dropout, Conv2D, MaxPool2D,
+    AvgPool2D, GlobalAvgPool2D, BatchNorm, Embedding, LSTM, Sequential,
+    register, layer_from_config, LAYER_REGISTRY,
+)
+from .model import Model, num_params

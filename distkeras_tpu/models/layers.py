@@ -1,0 +1,519 @@
+"""TPU-native layer/model API.
+
+This is the replacement for the reference's reliance on Keras model objects
+(dist-keras ships Keras models to Spark executors and calls
+``model.train_on_batch``; see reference ``distkeras/workers.py`` and
+``distkeras/utils.py:serialize_keras_model``).  Here a model is a pure
+function pair:
+
+    variables = model.init(rng)                     # {'params': ..., 'state': ...}
+    y, new_state = model.apply(variables, x, train=True, rng=rng)
+
+``params`` are trainable pytrees (differentiated through), ``state`` holds
+non-trainable mutables (BatchNorm running statistics).  Everything lowers to
+jit-friendly JAX: static shapes, ``lax.scan`` recurrence, no Python control
+flow on traced values — so the whole train step compiles onto the TPU MXU.
+
+Layer configs are JSON-serializable (``get_config``/``from_config``) which
+gives us the reference's architecture-JSON + weight-list serialization
+contract (reference ``distkeras/utils.py:serialize_keras_model``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+LAYER_REGISTRY: dict[str, type] = {}
+
+
+def register(cls):
+    """Register a layer class for config-based (de)serialization."""
+    LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def layer_from_config(cfg: dict) -> "Layer":
+    cls = LAYER_REGISTRY[cfg["class"]]
+    return cls.from_config(cfg["config"])
+
+
+# ---------------------------------------------------------------------------
+# initializers
+# ---------------------------------------------------------------------------
+
+def glorot_uniform(rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+    if fan_in is None or fan_out is None:
+        receptive = math.prod(shape[:-2]) if len(shape) > 2 else 1
+        fan_in = shape[-2] * receptive if len(shape) >= 2 else shape[-1]
+        fan_out = shape[-1] * receptive if len(shape) >= 2 else shape[-1]
+    limit = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(rng, shape, dtype, -limit, limit)
+
+
+def he_normal(rng, shape, dtype=jnp.float32):
+    receptive = math.prod(shape[:-2]) if len(shape) > 2 else 1
+    fan_in = (shape[-2] * receptive) if len(shape) >= 2 else shape[-1]
+    std = math.sqrt(2.0 / fan_in)
+    return jax.random.normal(rng, shape, dtype) * std
+
+
+def uniform_scale(rng, shape, scale=0.05, dtype=jnp.float32):
+    return jax.random.uniform(rng, shape, dtype, -scale, scale)
+
+
+ACTIVATIONS: dict[str, Callable] = {
+    "linear": lambda x: x,
+    "relu": jax.nn.relu,
+    "gelu": jax.nn.gelu,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    "softmax": lambda x: jax.nn.softmax(x, axis=-1),
+    "log_softmax": lambda x: jax.nn.log_softmax(x, axis=-1),
+    "elu": jax.nn.elu,
+    "silu": jax.nn.silu,
+    "leaky_relu": jax.nn.leaky_relu,
+}
+
+
+def get_activation(name_or_fn):
+    if name_or_fn is None:
+        return ACTIVATIONS["linear"]
+    if callable(name_or_fn):
+        return name_or_fn
+    return ACTIVATIONS[name_or_fn]
+
+
+def activation_config(name_or_fn):
+    """Serializable form of an activation spec; refuses silent loss."""
+    if name_or_fn is None or isinstance(name_or_fn, str):
+        return name_or_fn
+    for name, fn in ACTIVATIONS.items():
+        if fn is name_or_fn:
+            return name
+    raise ValueError(
+        f"cannot serialize custom activation {name_or_fn!r}; use a registered "
+        f"name ({', '.join(ACTIVATIONS)}) or an Activation layer subclass")
+
+
+# ---------------------------------------------------------------------------
+# base
+# ---------------------------------------------------------------------------
+
+class Layer:
+    """Base layer: pure-functional init/apply with explicit shapes.
+
+    ``init(rng, in_shape) -> (params, state, out_shape)`` where shapes
+    exclude the leading batch dimension.  ``apply(params, state, x, ...)``
+    returns ``(y, new_state)``.  Shapes are static so XLA traces once.
+    """
+
+    def init(self, rng, in_shape: tuple) -> tuple[Any, Any, tuple]:
+        return {}, {}, self.out_shape(in_shape)
+
+    def out_shape(self, in_shape: tuple) -> tuple:
+        return in_shape
+
+    def apply(self, params, state, x, *, train: bool = False, rng=None):
+        raise NotImplementedError
+
+    # -- config serde -------------------------------------------------------
+    def get_config(self) -> dict:
+        return {}
+
+    @classmethod
+    def from_config(cls, cfg: dict) -> "Layer":
+        return cls(**cfg)
+
+    def config(self) -> dict:
+        return {"class": type(self).__name__, "config": self.get_config()}
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v!r}" for k, v in self.get_config().items())
+        return f"{type(self).__name__}({args})"
+
+
+# ---------------------------------------------------------------------------
+# core layers
+# ---------------------------------------------------------------------------
+
+@register
+class Dense(Layer):
+    def __init__(self, units: int, activation=None, use_bias: bool = True):
+        self.units = int(units)
+        self.activation = activation
+        self.use_bias = use_bias
+        self._act = get_activation(activation)
+
+    def init(self, rng, in_shape):
+        (d,) = in_shape[-1:]
+        kr, _ = jax.random.split(rng)
+        params = {"kernel": glorot_uniform(kr, (d, self.units))}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.units,))
+        return params, {}, self.out_shape(in_shape)
+
+    def out_shape(self, in_shape):
+        return (*in_shape[:-1], self.units)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        y = x @ params["kernel"].astype(x.dtype)
+        if self.use_bias:
+            y = y + params["bias"].astype(x.dtype)
+        return self._act(y), state
+
+    def get_config(self):
+        return {
+            "units": self.units,
+            "activation": activation_config(self.activation),
+            "use_bias": self.use_bias,
+        }
+
+
+@register
+class Activation(Layer):
+    def __init__(self, activation: str):
+        self.activation = activation
+        self._act = get_activation(activation)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return self._act(x), state
+
+    def get_config(self):
+        return {"activation": self.activation}
+
+
+@register
+class Flatten(Layer):
+    def out_shape(self, in_shape):
+        return (math.prod(in_shape),)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return x.reshape(x.shape[0], -1), state
+
+
+@register
+class Reshape(Layer):
+    def __init__(self, target_shape: Sequence[int]):
+        self.target_shape = tuple(int(s) for s in target_shape)
+
+    def out_shape(self, in_shape):
+        return self.target_shape
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return x.reshape(x.shape[0], *self.target_shape), state
+
+    def get_config(self):
+        return {"target_shape": list(self.target_shape)}
+
+
+@register
+class Dropout(Layer):
+    def __init__(self, rate: float):
+        self.rate = float(rate)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        if not train or self.rate == 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError("Dropout needs an rng when train=True")
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype), state
+
+    def get_config(self):
+        return {"rate": self.rate}
+
+
+@register
+class Conv2D(Layer):
+    """NHWC conv lowering to ``lax.conv_general_dilated`` (MXU-tiled by XLA)."""
+
+    def __init__(self, filters: int, kernel_size, strides=1, padding="SAME",
+                 activation=None, use_bias: bool = True):
+        self.filters = int(filters)
+        self.kernel_size = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+        self.strides = (strides, strides) if isinstance(strides, int) else tuple(strides)
+        self.padding = padding
+        self.activation = activation
+        self.use_bias = use_bias
+        self._act = get_activation(activation)
+
+    def init(self, rng, in_shape):
+        h, w, c = in_shape
+        kh, kw = self.kernel_size
+        params = {"kernel": he_normal(rng, (kh, kw, c, self.filters))}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,))
+        return params, {}, self.out_shape(in_shape)
+
+    def out_shape(self, in_shape):
+        h, w, c = in_shape
+        sh, sw = self.strides
+        if self.padding == "SAME":
+            oh, ow = -(-h // sh), -(-w // sw)
+        else:
+            kh, kw = self.kernel_size
+            oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+        return (oh, ow, self.filters)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        y = lax.conv_general_dilated(
+            x, params["kernel"].astype(x.dtype),
+            window_strides=self.strides, padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.use_bias:
+            y = y + params["bias"].astype(x.dtype)
+        return self._act(y), state
+
+    def get_config(self):
+        return {
+            "filters": self.filters, "kernel_size": list(self.kernel_size),
+            "strides": list(self.strides), "padding": self.padding,
+            "activation": activation_config(self.activation),
+            "use_bias": self.use_bias,
+        }
+
+
+class _Pool2D(Layer):
+    _init_val: float
+    _op = None
+
+    def __init__(self, pool_size=2, strides=None, padding="VALID"):
+        self.pool_size = (pool_size, pool_size) if isinstance(pool_size, int) else tuple(pool_size)
+        self.strides = self.pool_size if strides is None else (
+            (strides, strides) if isinstance(strides, int) else tuple(strides))
+        self.padding = padding
+
+    def out_shape(self, in_shape):
+        h, w, c = in_shape
+        ph, pw = self.pool_size
+        sh, sw = self.strides
+        if self.padding == "SAME":
+            return (-(-h // sh), -(-w // sw), c)
+        return ((h - ph) // sh + 1, (w - pw) // sw + 1, c)
+
+    def _reduce(self, x):
+        return lax.reduce_window(
+            x, jnp.array(self._init_val, x.dtype), self._op,
+            (1, *self.pool_size, 1), (1, *self.strides, 1), self.padding)
+
+    def get_config(self):
+        return {"pool_size": list(self.pool_size), "strides": list(self.strides),
+                "padding": self.padding}
+
+
+@register
+class MaxPool2D(_Pool2D):
+    _init_val = -jnp.inf
+    _op = staticmethod(lax.max)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return self._reduce(x), state
+
+
+@register
+class AvgPool2D(_Pool2D):
+    _init_val = 0.0
+    _op = staticmethod(lax.add)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        total = self._reduce(x)
+        if self.padding == "SAME":
+            # average over valid (unpadded) elements only, like Keras
+            counts = lax.reduce_window(
+                jnp.ones(x.shape[1:3], x.dtype)[None, :, :, None],
+                jnp.array(0.0, x.dtype), lax.add,
+                (1, *self.pool_size, 1), (1, *self.strides, 1), self.padding)
+            return total / counts, state
+        return total / math.prod(self.pool_size), state
+
+
+@register
+class GlobalAvgPool2D(Layer):
+    def out_shape(self, in_shape):
+        return (in_shape[-1],)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return jnp.mean(x, axis=(1, 2)), state
+
+
+@register
+class BatchNorm(Layer):
+    """Batch normalization with running statistics kept in ``state``.
+
+    During distributed (SPMD) training the batch statistics are per-shard;
+    trainers that need cross-replica stats psum them via ``axis_name`` — we
+    follow the simpler per-shard convention (matches the reference, where
+    each Spark worker batch-norms its own minibatch independently).
+    """
+
+    def __init__(self, momentum: float = 0.9, epsilon: float = 1e-5,
+                 axis_name: Optional[str] = None):
+        self.momentum = float(momentum)
+        self.epsilon = float(epsilon)
+        self.axis_name = axis_name
+
+    def init(self, rng, in_shape):
+        c = in_shape[-1]
+        params = {"scale": jnp.ones((c,)), "bias": jnp.zeros((c,))}
+        state = {"mean": jnp.zeros((c,)), "var": jnp.ones((c,))}
+        return params, state, in_shape
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        reduce_axes = tuple(range(x.ndim - 1))
+        if train:
+            mean = jnp.mean(x, axis=reduce_axes)
+            var = jnp.var(x, axis=reduce_axes)
+            if self.axis_name is not None:
+                mean = lax.pmean(mean, self.axis_name)
+                var = lax.pmean(var, self.axis_name)
+            m = self.momentum
+            new_state = {"mean": m * state["mean"] + (1 - m) * mean.astype(jnp.float32),
+                         "var": m * state["var"] + (1 - m) * var.astype(jnp.float32)}
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = lax.rsqrt(var.astype(x.dtype) + jnp.asarray(self.epsilon, x.dtype))
+        y = (x - mean.astype(x.dtype)) * inv * params["scale"].astype(x.dtype) \
+            + params["bias"].astype(x.dtype)
+        return y, new_state
+
+    def get_config(self):
+        return {"momentum": self.momentum, "epsilon": self.epsilon,
+                "axis_name": self.axis_name}
+
+
+@register
+class Embedding(Layer):
+    def __init__(self, vocab_size: int, dim: int):
+        self.vocab_size = int(vocab_size)
+        self.dim = int(dim)
+
+    def init(self, rng, in_shape):
+        params = {"table": uniform_scale(rng, (self.vocab_size, self.dim))}
+        return params, {}, (*in_shape, self.dim)
+
+    def out_shape(self, in_shape):
+        return (*in_shape, self.dim)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return jnp.take(params["table"], x.astype(jnp.int32), axis=0), state
+
+    def get_config(self):
+        return {"vocab_size": self.vocab_size, "dim": self.dim}
+
+
+@register
+class LSTM(Layer):
+    """LSTM over the time axis via ``lax.scan`` (static-shape recurrence).
+
+    Replaces the reference's Keras LSTM layers (IMDB sentiment config in
+    BASELINE.json).  Gates are fused into one (in+h, 4h) matmul so each scan
+    step is a single MXU-shaped GEMM.
+    """
+
+    def __init__(self, units: int, return_sequences: bool = False):
+        self.units = int(units)
+        self.return_sequences = bool(return_sequences)
+
+    def init(self, rng, in_shape):
+        t, d = in_shape
+        k1, k2 = jax.random.split(rng)
+        h = self.units
+        params = {
+            "kernel": glorot_uniform(k1, (d, 4 * h)),
+            "recurrent": glorot_uniform(k2, (h, 4 * h)),
+            "bias": jnp.zeros((4 * h,)).at[h:2 * h].set(1.0),  # forget-gate bias 1
+        }
+        return params, {}, self.out_shape(in_shape)
+
+    def out_shape(self, in_shape):
+        t, d = in_shape
+        return (t, self.units) if self.return_sequences else (self.units,)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        b, t, d = x.shape
+        h = self.units
+        wk = params["kernel"].astype(x.dtype)
+        wr = params["recurrent"].astype(x.dtype)
+        bias = params["bias"].astype(x.dtype)
+        x_proj = x @ wk + bias  # (b, t, 4h): hoist input projection out of scan
+
+        def step(carry, xp):
+            hprev, cprev = carry
+            z = xp + hprev @ wr
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            c = jax.nn.sigmoid(f) * cprev + jax.nn.sigmoid(i) * jnp.tanh(g)
+            hnew = jax.nn.sigmoid(o) * jnp.tanh(c)
+            return (hnew, c), hnew
+
+        h0 = jnp.zeros((b, h), x.dtype)
+        (hT, _), hs = lax.scan(step, (h0, h0), jnp.swapaxes(x_proj, 0, 1))
+        if self.return_sequences:
+            return jnp.swapaxes(hs, 0, 1), state
+        return hT, state
+
+    def get_config(self):
+        return {"units": self.units, "return_sequences": self.return_sequences}
+
+
+# ---------------------------------------------------------------------------
+# composition
+# ---------------------------------------------------------------------------
+
+@register
+class Sequential(Layer):
+    """Keras-Sequential-style composition; the standard model container.
+
+    Parity surface for the reference's use of ``keras.models.Sequential`` in
+    its examples (``examples/mnist.ipynb``): same mental model, but lowering
+    to one pure jit-able function.
+    """
+
+    def __init__(self, layers: Sequence[Layer], input_shape: Optional[Sequence[int]] = None):
+        self.layers = list(layers)
+        self.input_shape = tuple(input_shape) if input_shape is not None else None
+
+    def init(self, rng, in_shape=None):
+        in_shape = tuple(in_shape) if in_shape is not None else self.input_shape
+        if in_shape is None:
+            raise ValueError("Sequential needs input_shape (constructor or init arg)")
+        params, state = [], []
+        shape = in_shape
+        for lyr in self.layers:
+            rng, sub = jax.random.split(rng)
+            p, s, shape = lyr.init(sub, shape)
+            params.append(p)
+            state.append(s)
+        return params, state, shape
+
+    def out_shape(self, in_shape):
+        shape = tuple(in_shape)
+        for lyr in self.layers:
+            shape = lyr.out_shape(shape)
+        return shape
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        new_state = []
+        for i, lyr in enumerate(self.layers):
+            sub = None
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            x, s = lyr.apply(params[i], state[i], x, train=train, rng=sub)
+            new_state.append(s)
+        return x, new_state
+
+    def get_config(self):
+        return {"layers": [l.config() for l in self.layers],
+                "input_shape": list(self.input_shape) if self.input_shape else None}
+
+    @classmethod
+    def from_config(cls, cfg):
+        return cls([layer_from_config(c) for c in cfg["layers"]],
+                   input_shape=cfg.get("input_shape"))

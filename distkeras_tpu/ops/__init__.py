@@ -1,0 +1,2 @@
+from .losses import get_loss, LOSSES
+from .optimizers import get_optimizer

@@ -1,0 +1,66 @@
+"""Loss functions.
+
+The reference passes Keras loss *names* into trainers
+(``distkeras/trainers.py`` — e.g. ``loss='categorical_crossentropy'``).  We
+keep the same string surface, resolving to pure JAX functions
+``loss(logits_or_probs, targets) -> scalar`` that differentiate and fuse
+cleanly under jit.
+
+Convention: model outputs are treated as *logits* for the crossentropy
+losses (numerically stable log-softmax inside the loss) — models therefore
+end in a linear layer, not a softmax.  A trailing ``softmax`` Activation is
+detected by trainers and stripped for training (the reference's Keras
+models end in softmax; this preserves that surface while staying stable).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+
+def categorical_crossentropy(logits, targets):
+    """targets: one-hot (batch, classes); logits: (batch, classes)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.sum(targets * logp, axis=-1))
+
+
+def sparse_categorical_crossentropy(logits, targets):
+    """targets: int class ids (batch,)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(
+        logp, targets.astype(jnp.int32)[:, None], axis=-1))
+
+
+def binary_crossentropy(logits, targets):
+    """targets in {0,1}, logits: raw scores (any shape)."""
+    logits = logits.reshape(targets.shape)
+    return jnp.mean(jnp.clip(logits, 0) - logits * targets
+                    + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+def mean_squared_error(preds, targets):
+    return jnp.mean((preds - targets) ** 2)
+
+
+def mean_absolute_error(preds, targets):
+    return jnp.mean(jnp.abs(preds - targets))
+
+
+LOSSES: dict[str, Callable] = {
+    "categorical_crossentropy": categorical_crossentropy,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "binary_crossentropy": binary_crossentropy,
+    "mean_squared_error": mean_squared_error,
+    "mse": mean_squared_error,
+    "mean_absolute_error": mean_absolute_error,
+    "mae": mean_absolute_error,
+}
+
+
+def get_loss(name_or_fn: Union[str, Callable]) -> Callable:
+    if callable(name_or_fn):
+        return name_or_fn
+    return LOSSES[name_or_fn]

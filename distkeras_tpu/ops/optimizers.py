@@ -1,0 +1,41 @@
+"""Worker optimizers.
+
+The reference hands Keras optimizer names/objects to trainers as the
+``worker_optimizer`` argument (``distkeras/trainers.py``).  We keep the
+string surface and resolve to optax gradient transformations — pure pytree
+update rules that live inside the jit-compiled train step.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import optax
+
+
+def get_optimizer(spec: Union[str, optax.GradientTransformation],
+                  learning_rate: float = 0.01) -> optax.GradientTransformation:
+    """Resolve an optimizer spec.
+
+    ``spec`` may be an optax ``GradientTransformation`` (used as-is), or one
+    of the Keras-style names the reference accepts: ``sgd``, ``momentum``,
+    ``nesterov``, ``adagrad``, ``adadelta``, ``rmsprop``, ``adam``.
+    """
+    if isinstance(spec, optax.GradientTransformation):
+        return spec
+    name = spec.lower()
+    if name == "sgd":
+        return optax.sgd(learning_rate)
+    if name == "momentum":
+        return optax.sgd(learning_rate, momentum=0.9)
+    if name == "nesterov":
+        return optax.sgd(learning_rate, momentum=0.9, nesterov=True)
+    if name == "adagrad":
+        return optax.adagrad(learning_rate)
+    if name == "adadelta":
+        return optax.adadelta(learning_rate)
+    if name == "rmsprop":
+        return optax.rmsprop(learning_rate)
+    if name == "adam":
+        return optax.adam(learning_rate)
+    raise ValueError(f"unknown optimizer {spec!r}")

@@ -1,0 +1,60 @@
+"""Utility surface — parity with reference ``distkeras/utils.py``."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from .serde import (tree_to_bytes, tree_from_bytes, serialize_model,
+                    deserialize_model)
+
+# Reference-parity aliases (``distkeras/utils.py:serialize_keras_model``).
+serialize_keras_model = serialize_model
+deserialize_keras_model = deserialize_model
+
+
+def shuffle(dataset, seed=None):
+    """Parity: ``distkeras/utils.py:shuffle(df)``."""
+    return dataset.shuffle(seed)
+
+
+def to_dense_vector(label, output_dim: int) -> np.ndarray:
+    """Parity: ``distkeras/utils.py:to_dense_vector`` (one-hot a label)."""
+    label, output_dim = int(label), int(output_dim)
+    if not 0 <= label < output_dim:
+        raise ValueError(f"label {label} out of range [0, {output_dim})")
+    v = np.zeros((output_dim,), dtype=np.float32)
+    v[label] = 1.0
+    return v
+
+
+def new_dataset_row(row: dict, col: str, value) -> dict:
+    """Parity: ``distkeras/utils.py:new_dataframe_row`` (append a column)."""
+    out = dict(row)
+    out[col] = value
+    return out
+
+
+new_dataframe_row = new_dataset_row
+
+
+def uniform_weights(variables: dict, seed: int = 0, bound: float = 0.05) -> dict:
+    """Re-initialize every param uniformly in [-bound, bound].
+
+    Parity: ``distkeras/utils.py:uniform_weights`` (used to decorrelate
+    ensemble members).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(variables["params"])
+    rng = jax.random.PRNGKey(seed)
+    keys = jax.random.split(rng, len(leaves))
+    new = [jax.random.uniform(k, l.shape, l.dtype, -bound, bound)
+           for k, l in zip(keys, leaves)]
+    return {"params": jax.tree_util.tree_unflatten(treedef, new),
+            "state": variables["state"]}
+
+
+def history_average(history: list) -> float:
+    """Average a loss history list (parity helper for the workflow plots)."""
+    if not history:
+        return float("nan")
+    return float(np.mean([h["loss"] if isinstance(h, dict) else h for h in history]))

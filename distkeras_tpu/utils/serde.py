@@ -1,0 +1,82 @@
+"""Pytree / model serialization.
+
+TPU-native replacement for the reference's pickle-based serde
+(``distkeras/utils.py:serialize_keras_model`` — architecture JSON + list of
+weight ndarrays — and ``distkeras/networking.py:send_data/recv_data`` which
+pickle arbitrary objects).  We use msgpack with an explicit, versioned
+ndarray encoding instead of pickle: safe to use as a wire format for the
+async parameter server and as the checkpoint format.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import jax.numpy as jnp
+import msgpack
+import numpy as np
+
+_ND = "__nd__"  # ndarray marker key
+
+
+def _default(obj):
+    if isinstance(obj, (np.ndarray, jnp.ndarray)):
+        arr = np.asarray(obj)
+        if arr.dtype == np.dtype("bfloat16"):
+            return {_ND: 1, "dtype": "bfloat16", "shape": list(arr.shape),
+                    "data": arr.view(np.uint16).tobytes()}
+        return {_ND: 1, "dtype": arr.dtype.str, "shape": list(arr.shape),
+                "data": arr.tobytes()}
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, tuple):
+        return list(obj)
+    raise TypeError(f"cannot serialize {type(obj)}")
+
+
+def _object_hook(obj):
+    if _ND in obj:
+        if obj["dtype"] == "bfloat16":
+            arr = np.frombuffer(obj["data"], dtype=np.uint16).view(
+                jnp.bfloat16.dtype).reshape(obj["shape"])
+        else:
+            arr = np.frombuffer(obj["data"], dtype=np.dtype(obj["dtype"])) \
+                    .reshape(obj["shape"])
+        return arr
+    return obj
+
+
+def tree_to_bytes(tree: Any) -> bytes:
+    """Serialize a pytree of ndarrays / scalars / dicts / lists to msgpack."""
+    return msgpack.packb(tree, default=_default, use_bin_type=True)
+
+
+def tree_from_bytes(data: bytes) -> Any:
+    return msgpack.unpackb(data, object_hook=_object_hook, raw=False,
+                           strict_map_key=False)
+
+
+# ---------------------------------------------------------------------------
+# model-level serde (parity: serialize_keras_model / deserialize_keras_model)
+# ---------------------------------------------------------------------------
+
+def serialize_model(model, variables: Any = None) -> bytes:
+    """Architecture config + variables blob.
+
+    Parity with reference ``distkeras/utils.py:serialize_keras_model(model)``
+    which returned ``{'model': model.to_json(), 'weights': model.get_weights()}``.
+    """
+    payload = {"arch": json.dumps(model.config()),
+               "variables": variables}
+    return tree_to_bytes(payload)
+
+
+def deserialize_model(data: bytes):
+    """Returns ``(model, variables)``; variables is None if not saved."""
+    from ..models.model import Model
+    payload = tree_from_bytes(data)
+    model = Model.from_config(json.loads(payload["arch"]))
+    return model, payload.get("variables")

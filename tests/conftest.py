@@ -1,0 +1,30 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+This is our equivalent of the reference's Spark ``local[*]`` trick
+(multi-worker semantics on one machine, SURVEY.md §4): 8 fake XLA devices
+exercise the real psum/mesh code paths without a TPU pod.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    d = jax.devices()
+    assert len(d) == 8, f"expected 8 fake devices, got {len(d)}"
+    return d
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,97 @@
+"""Data layer tests: Dataset partitioning + transformers (golden vectors)."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import (Dataset, OneHotTransformer, MinMaxTransformer,
+                                ReshapeTransformer, DenseTransformer,
+                                LabelIndexTransformer)
+
+
+def make_ds(n=10):
+    return Dataset({"features": np.arange(n * 4, dtype=np.float32).reshape(n, 4),
+                    "label": np.arange(n) % 3})
+
+
+def test_partitioning_covers_all_rows():
+    ds = make_ds(10).repartition(3)
+    assert ds.num_partitions == 3
+    assert sum(ds.partition_sizes()) == 10
+    rows = np.concatenate([p["features"] for p in ds.partitions()])
+    np.testing.assert_array_equal(rows, ds["features"])
+
+
+def test_repartition_clamps():
+    ds = make_ds(2).repartition(8)
+    assert ds.num_partitions == 2
+
+
+def test_shuffle_preserves_row_alignment():
+    ds = make_ds(20).shuffle(seed=0)
+    # row alignment: features row i sums to 4*label-derived pattern
+    f, l = ds["features"], ds["label"]
+    orig = make_ds(20)
+    for i in range(20):
+        j = int(f[i, 0] // 4)
+        np.testing.assert_array_equal(f[i], orig["features"][j])
+        assert l[i] == orig["label"][j]
+
+
+def test_stacked_shape():
+    ds = make_ds(20).repartition(4)
+    cols, steps = ds.stacked(["features"], batch_size=2)
+    assert steps == 2
+    assert cols["features"].shape == (4, 2, 2, 4)
+
+
+def test_onehot_golden():
+    ds = Dataset({"label": np.array([0, 2, 1])})
+    out = OneHotTransformer(3, "label", "oh").transform(ds)
+    np.testing.assert_array_equal(
+        out["oh"], np.array([[1, 0, 0], [0, 0, 1], [0, 1, 0]], np.float32))
+
+
+def test_minmax_golden():
+    ds = Dataset({"features": np.array([[0.0, 127.5, 255.0]])})
+    out = MinMaxTransformer(0, 1, 0, 255, "features", "n").transform(ds)
+    np.testing.assert_allclose(out["n"], np.array([[0.0, 0.5, 1.0]]), rtol=1e-6)
+
+
+def test_reshape_transformer():
+    ds = Dataset({"features": np.zeros((5, 12), np.float32)})
+    out = ReshapeTransformer("features", "img", (2, 3, 2)).transform(ds)
+    assert out["img"].shape == (5, 2, 3, 2)
+
+
+def test_dense_transformer_idempotent():
+    ds = Dataset({"features": np.ones((3, 2), np.float64)})
+    out = DenseTransformer("features", "d").transform(ds)
+    assert out["d"].dtype == np.float32
+
+
+def test_label_index_argmax_and_binary():
+    ds = Dataset({"prediction": np.array([[0.1, 0.8, 0.1], [0.7, 0.2, 0.1]])})
+    out = LabelIndexTransformer(3, "prediction", "idx").transform(ds)
+    np.testing.assert_array_equal(out["idx"], np.array([1.0, 0.0]))
+    ds2 = Dataset({"prediction": np.array([0.9, 0.2])})
+    out2 = LabelIndexTransformer(1, "prediction", "idx").transform(ds2)
+    np.testing.assert_array_equal(out2["idx"], np.array([1.0, 0.0]))
+
+
+def test_select_drop_with_column():
+    ds = make_ds(4)
+    assert ds.select("label").column_names == ["label"]
+    assert "label" not in ds.drop("label").column_names
+    ds2 = ds.with_column("z", np.zeros(4))
+    assert "z" in ds2.column_names
+    with pytest.raises(ValueError):
+        ds.with_column("bad", np.zeros(5))
+
+
+def test_onehot_rejects_out_of_range():
+    ds = Dataset({"label": np.array([0, -1, 2])})
+    with pytest.raises(ValueError, match="labels must be in"):
+        OneHotTransformer(3, "label", "oh").transform(ds)
+    ds2 = Dataset({"label": np.array([0, 3])})
+    with pytest.raises(ValueError):
+        OneHotTransformer(3, "label", "oh").transform(ds2)

@@ -1,0 +1,152 @@
+"""Unit tests: layer math, shapes, and model init/apply."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.models import (
+    Model, Sequential, Dense, Conv2D, MaxPool2D, AvgPool2D, GlobalAvgPool2D,
+    Flatten, Reshape, Dropout, BatchNorm, Embedding, LSTM, Activation,
+    num_params,
+)
+
+
+def test_dense_shapes_and_values():
+    m = Model(Sequential([Dense(4, activation="relu")]), input_shape=(3,))
+    v = m.init(0)
+    x = jnp.ones((2, 3))
+    y, _ = m.apply(v, x)
+    assert y.shape == (2, 4)
+    # relu output non-negative
+    assert (np.asarray(y) >= 0).all()
+
+
+def test_mlp_forward_jit():
+    m = Model(Sequential([Dense(32, "relu"), Dense(10)]), input_shape=(784,))
+    v = m.init(0)
+    fn = jax.jit(m.predict_fn())
+    y = fn(v, jnp.zeros((8, 784)))
+    assert y.shape == (8, 10)
+    assert num_params(v) == 784 * 32 + 32 + 32 * 10 + 10
+
+
+def test_conv_stack_shapes():
+    m = Model(Sequential([
+        Conv2D(8, 3, activation="relu"),
+        MaxPool2D(2),
+        Conv2D(16, 3, strides=2),
+        GlobalAvgPool2D(),
+        Dense(10),
+    ]), input_shape=(28, 28, 1))
+    assert m.output_shape == (10,)
+    v = m.init(1)
+    y, _ = m.apply(v, jnp.ones((4, 28, 28, 1)))
+    assert y.shape == (4, 10)
+
+
+def test_avgpool_matches_manual():
+    m = Model(Sequential([AvgPool2D(2)]), input_shape=(4, 4, 1))
+    v = m.init(0)
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    y, _ = m.apply(v, x)
+    assert y.shape == (1, 2, 2, 1)
+    np.testing.assert_allclose(np.asarray(y)[0, 0, 0, 0], (0 + 1 + 4 + 5) / 4)
+
+
+def test_flatten_reshape_roundtrip():
+    m = Model(Sequential([Flatten(), Reshape((7, 4))]), input_shape=(7, 4))
+    v = m.init(0)
+    x = jnp.arange(28.0).reshape(1, 7, 4)
+    y, _ = m.apply(v, x)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_dropout_train_vs_eval():
+    m = Model(Sequential([Dropout(0.5)]), input_shape=(100,))
+    v = m.init(0)
+    x = jnp.ones((4, 100))
+    y_eval, _ = m.apply(v, x, train=False)
+    np.testing.assert_array_equal(np.asarray(y_eval), np.asarray(x))
+    y_train, _ = m.apply(v, x, train=True, rng=jax.random.PRNGKey(0))
+    y_np = np.asarray(y_train)
+    assert ((y_np == 0) | (y_np == 2.0)).all()
+    assert (y_np == 0).any()
+
+
+def test_batchnorm_normalizes_and_updates_state():
+    m = Model(Sequential([BatchNorm(momentum=0.5)]), input_shape=(3,))
+    v = m.init(0)
+    x = jnp.asarray(np.random.default_rng(0).normal(5.0, 2.0, (64, 3)), jnp.float32)
+    y, new_state = m.apply(v, x, train=True)
+    y_np = np.asarray(y)
+    np.testing.assert_allclose(y_np.mean(0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(y_np.std(0), 1.0, atol=1e-2)
+    # running stats moved toward batch stats
+    assert not np.allclose(np.asarray(new_state[0]["mean"]), 0.0)
+    # eval mode uses running stats, doesn't mutate
+    v2 = {"params": v["params"], "state": new_state}
+    _, st2 = m.apply(v2, x, train=False)
+    np.testing.assert_array_equal(np.asarray(st2[0]["mean"]),
+                                  np.asarray(new_state[0]["mean"]))
+
+
+def test_embedding_lookup():
+    m = Model(Sequential([Embedding(10, 4)]), input_shape=(5,))
+    v = m.init(0)
+    y, _ = m.apply(v, jnp.zeros((2, 5), jnp.int32))
+    assert y.shape == (2, 5, 4)
+
+
+def test_lstm_shapes_and_determinism():
+    m = Model(Sequential([Embedding(50, 8), LSTM(16), Dense(1)]),
+              input_shape=(12,))
+    v = m.init(0)
+    x = jnp.asarray(np.random.default_rng(0).integers(0, 50, (3, 12)))
+    y1, _ = m.apply(v, x)
+    y2, _ = m.apply(v, x)
+    assert y1.shape == (3, 1)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+
+
+def test_lstm_return_sequences():
+    m = Model(Sequential([LSTM(6, return_sequences=True)]), input_shape=(4, 3))
+    v = m.init(0)
+    y, _ = m.apply(v, jnp.ones((2, 4, 3)))
+    assert y.shape == (2, 4, 6)
+
+
+def test_lstm_grads_flow():
+    m = Model(Sequential([LSTM(8), Dense(1)]), input_shape=(6, 4))
+    v = m.init(0)
+    x = jnp.ones((2, 6, 4))
+
+    def loss(params):
+        y, _ = m.layer.apply(params, v["state"], x)
+        return jnp.mean(y ** 2)
+
+    g = jax.grad(loss)(v["params"])
+    gnorm = sum(float(jnp.abs(l).sum()) for l in jax.tree_util.tree_leaves(g))
+    assert gnorm > 0
+
+
+def test_activation_softmax():
+    m = Model(Sequential([Activation("softmax")]), input_shape=(5,))
+    y, _ = m.apply(m.init(0), jnp.ones((2, 5)))
+    np.testing.assert_allclose(np.asarray(y).sum(-1), 1.0, rtol=1e-5)
+
+
+def test_avgpool_same_padding_edge_correct():
+    # regression: SAME padding must average over valid elements only
+    m = Model(Sequential([AvgPool2D(2, strides=1, padding="SAME")]),
+              input_shape=(2, 2, 1))
+    v = m.init(0)
+    y, _ = m.apply(v, jnp.ones((1, 2, 2, 1)))
+    np.testing.assert_allclose(np.asarray(y), 1.0)
+
+
+def test_custom_activation_serde_refused():
+    d = Dense(3, activation=jax.nn.relu)  # callable resolvable to a name
+    assert d.get_config()["activation"] == "relu"
+    with pytest.raises(ValueError, match="cannot serialize"):
+        Dense(3, activation=lambda x: x * 2).get_config()
