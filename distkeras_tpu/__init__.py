@@ -9,6 +9,20 @@ and a host-side asynchronous parameter server (async-parity path).
 
 __version__ = "0.1.0"
 
-from . import data, models, ops, utils
+from . import data, models, ops, parallel, utils
 from .data import Dataset
 from .models import Model, Sequential
+from .trainers import (
+    ADAG,
+    AEASGD,
+    DOWNPOUR,
+    AveragingTrainer,
+    DistributedTrainer,
+    DynSGD,
+    EAMSGD,
+    EnsembleTrainer,
+    SingleTrainer,
+    Trainer,
+)
+from .predictors import ModelPredictor, Predictor
+from .evaluators import AccuracyEvaluator, Evaluator, F1Evaluator, LossEvaluator

@@ -1,0 +1,94 @@
+"""Evaluation — parity with reference ``distkeras/evaluators.py``.
+
+The reference evaluates predicted DataFrames on the driver (and its
+notebooks also use Spark's ``MulticlassClassificationEvaluator``).  Ours
+are vectorized NumPy/JAX reductions over Dataset columns with the same
+``.evaluate(ds) -> float`` surface.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .data.dataset import Dataset
+
+
+class Evaluator:
+    """Base evaluator (reference ``distkeras/evaluators.py:Evaluator``)."""
+
+    def __init__(self, prediction_col: str = "prediction",
+                 label_col: str = "label"):
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def evaluate(self, dataset: Dataset) -> float:
+        raise NotImplementedError
+
+
+def _to_class_index(a: np.ndarray, threshold: float = 0.5) -> np.ndarray:
+    """Accept class indices, one-hot/probability vectors, or (for the
+    binary 1-column case) sigmoid probabilities thresholded at 0.5."""
+    a = np.asarray(a)
+    if a.ndim >= 2 and a.shape[-1] > 1:
+        return np.argmax(a, axis=-1)
+    flat = a.reshape(a.shape[0])
+    if np.issubdtype(flat.dtype, np.floating) and flat.size and \
+            not np.all(flat == np.round(flat)):
+        return (flat >= threshold).astype(np.int64)
+    return flat.astype(np.int64)
+
+
+class AccuracyEvaluator(Evaluator):
+    """Classification accuracy.  Both columns may hold class indices,
+    one-hot labels, or probability vectors (the reference pipeline first
+    runs ``LabelIndexTransformer``; we accept raw vectors too)."""
+
+    def evaluate(self, dataset: Dataset) -> float:
+        pred = _to_class_index(dataset[self.prediction_col])
+        label = _to_class_index(dataset[self.label_col])
+        return float(np.mean(pred == label))
+
+
+class F1Evaluator(Evaluator):
+    """Macro-averaged F1 (the reference notebooks report Spark's F1 metric
+    via ``MulticlassClassificationEvaluator``)."""
+
+    def evaluate(self, dataset: Dataset) -> float:
+        pred = _to_class_index(dataset[self.prediction_col])
+        label = _to_class_index(dataset[self.label_col])
+        classes = np.unique(np.concatenate([pred, label]))
+        f1s = []
+        for c in classes:
+            tp = np.sum((pred == c) & (label == c))
+            fp = np.sum((pred == c) & (label != c))
+            fn = np.sum((pred != c) & (label == c))
+            denom = 2 * tp + fp + fn
+            f1s.append(2 * tp / denom if denom else 0.0)
+        return float(np.mean(f1s))
+
+
+class LossEvaluator(Evaluator):
+    """Mean of a loss function over prediction/label columns.
+
+    ``outputs`` says what the prediction column holds: ``"probs"`` (the
+    default — ``ModelPredictor`` on the reference-style softmax-ending
+    models yields probabilities) resolves crossentropy names to the on-probs
+    variants; ``"logits"`` uses the logit forms.
+    """
+
+    def __init__(self, loss="categorical_crossentropy",
+                 prediction_col: str = "prediction", label_col: str = "label",
+                 outputs: str = "probs"):
+        super().__init__(prediction_col, label_col)
+        from .ops.losses import get_loss, probs_loss_variant
+        self.loss_fn = None
+        if outputs == "probs" and isinstance(loss, str):
+            self.loss_fn = probs_loss_variant(loss)
+        if self.loss_fn is None:
+            self.loss_fn = get_loss(loss)
+
+    def evaluate(self, dataset: Dataset) -> float:
+        import jax.numpy as jnp
+        pred = jnp.asarray(dataset[self.prediction_col])
+        label = jnp.asarray(dataset[self.label_col])
+        return float(self.loss_fn(pred, label))

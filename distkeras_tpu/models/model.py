@@ -28,6 +28,9 @@ class Model:
         self.input_shape = tuple(input_shape)
         self.name = name
         self.output_shape = layer.out_shape(self.input_shape)
+        #: trained variables pytree, attached by trainers after ``train()``
+        #: (the reference returns a weight-laden Keras model the same way)
+        self.variables: Optional[dict] = None
 
     # -- functional API -----------------------------------------------------
     def init(self, rng=0) -> dict:

@@ -6,11 +6,12 @@ keep the same string surface, resolving to pure JAX functions
 ``loss(logits_or_probs, targets) -> scalar`` that differentiate and fuse
 cleanly under jit.
 
-Convention: model outputs are treated as *logits* for the crossentropy
-losses (numerically stable log-softmax inside the loss) — models therefore
-end in a linear layer, not a softmax.  A trailing ``softmax`` Activation is
-detected by trainers and stripped for training (the reference's Keras
-models end in softmax; this preserves that surface while staying stable).
+Convention: the named crossentropy losses here treat model outputs as
+*logits* (numerically stable log-softmax inside the loss).  The reference's
+Keras models end in a softmax layer, so trainers detect a trailing softmax
+and swap in the ``*_from_probs`` variants below (clipped-log, exactly the
+Keras semantics) — the model surface stays identical to the reference and
+nothing is stripped.
 """
 
 from __future__ import annotations
@@ -64,3 +65,41 @@ def get_loss(name_or_fn: Union[str, Callable]) -> Callable:
     if callable(name_or_fn):
         return name_or_fn
     return LOSSES[name_or_fn]
+
+
+# -- on-probabilities variants (Keras semantics) ----------------------------
+# The reference's models end in a softmax layer and its losses therefore see
+# probabilities, not logits (Keras ``categorical_crossentropy``).  Trainers
+# that detect a trailing softmax swap in these clipped-log variants so the
+# model surface can stay identical to the reference.
+
+_EPS = 1e-7
+
+
+def categorical_crossentropy_from_probs(probs, targets):
+    p = jnp.clip(probs, _EPS, 1.0)
+    return -jnp.mean(jnp.sum(targets * jnp.log(p), axis=-1))
+
+
+def sparse_categorical_crossentropy_from_probs(probs, targets):
+    p = jnp.clip(probs, _EPS, 1.0)
+    logp = jnp.log(p)
+    return -jnp.mean(jnp.take_along_axis(
+        logp, targets.astype(jnp.int32)[:, None], axis=-1))
+
+
+def binary_crossentropy_from_probs(probs, targets):
+    p = jnp.clip(probs.reshape(targets.shape), _EPS, 1.0 - _EPS)
+    return -jnp.mean(targets * jnp.log(p) + (1 - targets) * jnp.log1p(-p))
+
+
+_PROBS_VARIANTS: dict[str, Callable] = {
+    "categorical_crossentropy": categorical_crossentropy_from_probs,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy_from_probs,
+    "binary_crossentropy": binary_crossentropy_from_probs,
+}
+
+
+def probs_loss_variant(name: str):
+    """On-probs variant of a named loss, or None if not a crossentropy."""
+    return _PROBS_VARIANTS.get(name)

@@ -1,0 +1,21 @@
+"""Parallelism: device meshes, SPMD sync engine, collective helpers.
+
+This package is the TPU-native replacement for the reference's entire
+distribution substrate (Spark executors + socket parameter server; reference
+``distkeras/parameter_servers.py``, ``distkeras/networking.py``).  The sync
+path formulates every dist-keras algorithm as an SPMD program over a
+``jax.sharding.Mesh``: local shard training inside ``shard_map`` +
+XLA collectives (``psum``/``pmean``) at communication-window edges — the
+pull/commit round-trip of the reference collapses into one fused allreduce
+riding ICI.
+"""
+
+from .mesh import make_mesh, shard_map  # noqa: F401
+from .sync import (  # noqa: F401
+    SyncEngine,
+    AdagSync,
+    DownpourSync,
+    DynSgdSync,
+    EasgdSync,
+    NoCommSync,
+)

@@ -3,16 +3,22 @@
 This is our equivalent of the reference's Spark ``local[*]`` trick
 (multi-worker semantics on one machine, SURVEY.md §4): 8 fake XLA devices
 exercise the real psum/mesh code paths without a TPU pod.
+
+NOTE: in this environment jax may be pre-imported by an interpreter startup
+hook (TPU tunnel), so ``os.environ['JAX_PLATFORMS']`` is too late —
+``jax.config.update`` before first backend use is the reliable path.
+XLA_FLAGS must still be in the environment before the CPU client spins up.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,138 @@
+"""Sync-mode trainer family: every algorithm end-to-end on 8 fake devices.
+
+This is our equivalent of the reference's ``examples/workflow.ipynb``
+(SURVEY.md §4): all trainers on one problem, checked for convergence
+against the SingleTrainer anchor.
+"""
+
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.data.transformers import OneHotTransformer
+from distkeras_tpu.models.layers import Dense, Sequential
+from distkeras_tpu.parallel.sync import (AdagSync, DownpourSync, DynSgdSync,
+                                         EasgdSync)
+
+
+def toy_problem(n=2048, d=10, k=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, k)).astype(np.float32)
+    y = np.argmax(x @ w + 0.1 * rng.normal(size=(n, k)), axis=-1)
+    ds = dk.Dataset({"features": x, "label": y})
+    return OneHotTransformer(k, "label", "label_onehot").transform(ds)
+
+
+def make_model(d=10, k=3):
+    return dk.Model(Sequential([Dense(32, "relu"), Dense(k, "softmax")]),
+                    input_shape=(d,))
+
+
+COMMON = dict(loss="categorical_crossentropy", features_col="features",
+              label_col="label_onehot", num_epoch=3, batch_size=32,
+              learning_rate=0.05)
+
+
+def accuracy(model, ds):
+    pred = dk.ModelPredictor(model, "features").predict(ds)
+    return dk.AccuracyEvaluator("prediction", "label").evaluate(pred)
+
+
+@pytest.fixture(scope="module")
+def ds():
+    return toy_problem()
+
+
+def test_single_trainer_anchor(ds):
+    t = dk.SingleTrainer(make_model(), "sgd", **COMMON)
+    m = t.train(ds)
+    assert accuracy(m, ds) > 0.9
+    assert t.get_training_time() > 0
+    assert len(t.get_history()) == COMMON["num_epoch"]
+    assert t.get_averaged_history()[-1] < t.get_averaged_history()[0]
+
+
+@pytest.mark.parametrize("cls,kw,floor", [
+    (dk.ADAG, dict(communication_window=4), 0.55),
+    (dk.DOWNPOUR, dict(communication_window=4), 0.9),
+    (dk.DynSGD, dict(communication_window=4), 0.9),
+    (dk.AEASGD, dict(communication_window=4, rho=1.0), 0.5),
+    (dk.EAMSGD, dict(communication_window=4, rho=1.0, momentum=0.9), 0.8),
+    (dk.AveragingTrainer, {}, 0.55),
+])
+def test_distributed_trainers(ds, cls, kw, floor):
+    t = cls(make_model(), "sgd", num_workers=8, **COMMON, **kw)
+    m = t.train(ds)
+    assert accuracy(m, ds) > floor
+    assert t.get_history()[0].shape[0] == 8  # per-worker loss history
+
+
+def test_ensemble_trainer(ds):
+    t = dk.EnsembleTrainer(make_model(), "sgd", num_ensembles=8, **COMMON)
+    models = t.train(ds)
+    assert len(models) == 8
+    accs = [accuracy(m, ds) for m in models[:2]]
+    assert all(a > 0.5 for a in accs)
+    # different seeds -> genuinely different members
+    l0 = models[0].variables["params"][0]["kernel"]
+    l1 = models[1].variables["params"][0]["kernel"]
+    assert not np.allclose(l0, l1)
+
+
+def test_downpour_equals_single_with_one_worker(ds):
+    """With 1 worker and window 1, DOWNPOUR's sync limit IS plain SGD: it
+    must match the SingleTrainer bitwise-ish (same seed, same data)."""
+    a = dk.SingleTrainer(make_model(), "sgd", **COMMON, seed=7)
+    b = dk.DOWNPOUR(make_model(), "sgd", num_workers=1,
+                    communication_window=1, **COMMON, seed=7)
+    ma = a.train(ds)
+    mb = b.train(ds)
+    ka = ma.variables["params"][0]["kernel"]
+    kb = mb.variables["params"][0]["kernel"]
+    np.testing.assert_allclose(np.asarray(ka), np.asarray(kb),
+                               rtol=2e-4, atol=2e-5)
+
+
+# -- pure communication-rule math (reference PS update rules as pure fns) --
+
+def test_comm_rule_math():
+    import jax
+    from distkeras_tpu.parallel.mesh import make_mesh, shard_map
+    from jax.sharding import PartitionSpec as P
+    import jax.numpy as jnp
+    import inspect
+
+    mesh = make_mesh(8)
+    kw = ({"check_vma": False}
+          if "check_vma" in inspect.signature(shard_map).parameters
+          else {"check_rep": False})
+    center = jnp.zeros((4,))
+    local = jnp.arange(32, dtype=jnp.float32).reshape(8, 4)
+
+    def run(algo):
+        def f(c, l):
+            c2, l2 = algo.communicate(c, l[0], "workers")
+            return c2, l2[None]
+        return shard_map(f, mesh=mesh, in_specs=(P(), P("workers")),
+                         out_specs=(P(), P("workers")), **kw)(center, local)
+
+    # ADAG: center <- mean of locals; locals reset to center
+    c2, l2 = run(AdagSync())
+    np.testing.assert_allclose(c2, np.mean(np.asarray(local), 0), rtol=1e-6)
+    np.testing.assert_allclose(l2, np.tile(c2, (8, 1)), rtol=1e-6)
+
+    # DOWNPOUR: center <- center + sum(local - center)
+    c2, _ = run(DownpourSync())
+    np.testing.assert_allclose(c2, np.sum(np.asarray(local), 0), rtol=1e-6)
+
+    # DynSGD at staleness 0 == DOWNPOUR
+    c3, _ = run(DynSgdSync())
+    np.testing.assert_allclose(c3, c2, rtol=1e-6)
+
+    # EASGD: E_k = a(l_k - c); l_k -= E_k; c += sum E_k
+    a = 0.25
+    c2, l2 = run(EasgdSync(a))
+    E = a * (np.asarray(local) - np.asarray(center))
+    np.testing.assert_allclose(l2, np.asarray(local) - E, rtol=1e-6)
+    np.testing.assert_allclose(c2, np.asarray(center) + E.sum(0), rtol=1e-6)
