@@ -23,7 +23,6 @@ pull/commit — includes BatchNorm running statistics.
 
 from __future__ import annotations
 
-import functools
 import inspect
 from typing import Any, Callable, NamedTuple, Optional
 
@@ -72,6 +71,58 @@ def _shard_map_kw():
     if "check_vma" in params:
         return {"check_vma": False}
     return {"check_rep": False}
+
+
+# ---------------------------------------------------------------------------
+# the local minibatch step (shared by sync engine and async PS workers)
+# ---------------------------------------------------------------------------
+
+def make_local_step(model, loss_fn: Callable,
+                    optimizer: optax.GradientTransformation,
+                    compute_dtype=None):
+    """One minibatch of local optimization as a pure scan-able function:
+    ``step((variables, opt_state, rng), (x, y)) -> (carry', loss)``.
+
+    This is the reference's ``model.train_on_batch`` (reference
+    ``distkeras/workers.py``) as a jit-compiled value_and_grad + optax
+    update — the MXU hot loop.
+    """
+
+    def step(carry, batch):
+        variables, opt_state, rng = carry
+        x, y = batch
+        if compute_dtype is not None:
+            x = x.astype(compute_dtype)
+        rng, sub = jax.random.split(rng)
+
+        def loss_of(params):
+            out, new_state = model.layer.apply(
+                params, variables["state"], x, train=True, rng=sub)
+            return loss_fn(out, y), new_state
+
+        (loss_val, new_state), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(variables["params"])
+        updates, opt_state = optimizer.update(
+            grads, opt_state, variables["params"])
+        params = optax.apply_updates(variables["params"], updates)
+        return ({"params": params, "state": new_state}, opt_state, rng), loss_val
+
+    return step
+
+
+def make_window_fn(model, loss_fn, optimizer, compute_dtype=None):
+    """jit-compiled window scan: ``(variables, opt_state, rng, xs, ys) ->
+    (variables, opt_state, rng, losses)`` over the leading (steps) axis —
+    the unit of work between two parameter-server interactions."""
+    step = make_local_step(model, loss_fn, optimizer, compute_dtype)
+
+    @jax.jit
+    def run(variables, opt_state, rng, xs, ys):
+        (variables, opt_state, rng), losses = lax.scan(
+            step, (variables, opt_state, rng), (xs, ys))
+        return variables, opt_state, rng, losses
+
+    return run
 
 
 # ---------------------------------------------------------------------------
@@ -199,26 +250,8 @@ class SyncEngine:
         self.axis = axis
         self.mesh = mesh if mesh is not None else make_mesh(num_workers, (axis,))
         self.compute_dtype = compute_dtype
-
-    # -- the local minibatch step (shared by sync + single paths) ----------
-    def _local_step(self, carry, batch):
-        variables, opt_state, rng = carry
-        x, y = batch
-        if self.compute_dtype is not None:
-            x = x.astype(self.compute_dtype)
-        rng, sub = jax.random.split(rng)
-
-        def loss_of(params):
-            out, new_state = self.model.layer.apply(
-                params, variables["state"], x, train=True, rng=sub)
-            return self.loss_fn(out, y), new_state
-
-        (loss_val, new_state), grads = jax.value_and_grad(
-            loss_of, has_aux=True)(variables["params"])
-        updates, opt_state = self.optimizer.update(
-            grads, opt_state, variables["params"])
-        params = optax.apply_updates(variables["params"], updates)
-        return ({"params": params, "state": new_state}, opt_state, rng), loss_val
+        self._local_step = make_local_step(model, loss_fn, optimizer,
+                                           compute_dtype)
 
     # -- distributed epoch --------------------------------------------------
     def epoch_fn(self):
@@ -260,11 +293,3 @@ class SyncEngine:
 
         return run
 
-    # -- single-worker epoch (SingleTrainer; no mesh) ----------------------
-    def single_epoch_fn(self):
-        @jax.jit
-        def run(variables, opt_state, rng, xs, ys):
-            (variables, opt_state, rng), losses = lax.scan(
-                self._local_step, (variables, opt_state, rng), (xs, ys))
-            return variables, opt_state, rng, losses
-        return run

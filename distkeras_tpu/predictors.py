@@ -17,7 +17,6 @@ import numpy as np
 
 from .data.dataset import Dataset
 from .models.model import Model
-from .parallel import mesh as mesh_lib
 
 
 class Predictor:
@@ -54,6 +53,10 @@ class ModelPredictor(Predictor):
     def predict(self, dataset: Dataset) -> Dataset:
         x = dataset[self.features_col]
         n = x.shape[0]
+        if n == 0:
+            out_shape = self.model.output_shape
+            return dataset.with_column(
+                self.output_col, np.zeros((0, *out_shape), np.float32))
         fn = self._fn
 
         bs = min(self.batch_size, n)

@@ -1,0 +1,24 @@
+"""Asynchronous parameter server — the reference's behavioral twin.
+
+The sync engine (``distkeras_tpu.parallel.sync``) is the idiomatic TPU
+formulation, but it is the *synchronous limit* of each algorithm: staleness
+is identically zero.  The reference's defining behaviors — true asynchrony,
+per-commit update rules, DynSGD's staleness scaling — need a real shared
+center variable that workers hit at their own pace.  This package provides
+it: a host-side TCP parameter server (star topology, mutex-guarded commits,
+per-connection threads — structurally the reference's
+``distkeras/parameter_servers.py`` + ``distkeras/networking.py``) speaking
+length-prefixed **msgpack** (never pickle) over localhost or DCN, with
+workers running jit-compiled window scans on their device between pulls and
+commits.
+"""
+
+from .networking import connect, determine_host_address, recv_msg, send_msg  # noqa: F401
+from .servers import (  # noqa: F401
+    ADAGParameterServer,
+    DeltaParameterServer,
+    DynSGDParameterServer,
+    ParameterServer,
+    SocketParameterServer,
+)
+from .client import PSClient  # noqa: F401

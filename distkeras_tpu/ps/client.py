@@ -1,0 +1,52 @@
+"""Worker-side PS client: one persistent connection, pull/commit calls.
+
+Parity with the reference's worker-side socket usage (reference
+``distkeras/workers.py:NetworkWorker.pull``/``commit``): full center down,
+delta up, at communication-window boundaries.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .networking import connect, recv_msg, send_msg
+
+
+class PSClient:
+    def __init__(self, host: str, port: int, worker_id: int = 0):
+        self.worker_id = int(worker_id)
+        self.sock = connect(host, port)
+
+    def pull(self) -> tuple:
+        """Returns ``(center_tree, server_update_counter)``."""
+        send_msg(self.sock, {"action": "pull", "worker_id": self.worker_id})
+        resp = recv_msg(self.sock)
+        return resp["center"], int(resp["updates"])
+
+    def commit(self, delta: Any, last_update: Optional[int] = None) -> bool:
+        """Commit a delta; returns False if a fault injector dropped it."""
+        msg = {"action": "commit", "worker_id": self.worker_id,
+               "delta": delta}
+        if last_update is not None:
+            msg["last_update"] = int(last_update)
+        send_msg(self.sock, msg)
+        resp = recv_msg(self.sock)
+        return not resp.get("dropped", False)
+
+    def close(self) -> None:
+        try:
+            send_msg(self.sock, {"action": "stop"})
+            recv_msg(self.sock)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,72 @@
+"""TCP message layer — parity with reference ``distkeras/networking.py``.
+
+Same surface (``determine_host_address``, ``connect``, send/recv of whole
+messages), different wire format: the reference pickles arbitrary objects
+(``send_data``/``recv_data``); we frame **msgpack** blobs with a uint64
+length prefix via ``utils.serde`` — safe against arbitrary-code
+deserialization and identical across hosts.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import time
+from typing import Any, Optional
+
+from ..utils import serde
+
+_LEN = struct.Struct(">Q")
+
+
+def determine_host_address() -> str:
+    """Routable local IP via the UDP-connect trick (parity: reference
+    ``distkeras/networking.py:determine_host_address``)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def connect(host: str, port: int, timeout: Optional[float] = 30.0,
+            retries: int = 20, retry_delay: float = 0.1) -> socket.socket:
+    """Connect with retries (the PS thread may not be listening yet —
+    the reference relied on Spark task startup latency to hide this)."""
+    last = None
+    for _ in range(max(1, retries)):
+        try:
+            s = socket.create_connection((host, port), timeout=timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return s
+        except OSError as e:
+            last = e
+            time.sleep(retry_delay)
+    raise ConnectionError(f"cannot connect to {host}:{port}: {last}")
+
+
+def send_msg(sock: socket.socket, obj: Any) -> None:
+    """Length-prefixed msgpack send (parity: reference ``send_data``)."""
+    blob = serde.tree_to_bytes(obj)
+    sock.sendall(_LEN.pack(len(blob)) + blob)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("socket closed mid-message")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    """Recv-all loop for one framed message (parity: reference
+    ``recv_data``)."""
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return serde.tree_from_bytes(_recv_exact(sock, n))

@@ -1,0 +1,78 @@
+"""Async-mode training driver — the reference's ``DistributedTrainer.train``
+orchestration (start PS → ship workers → join → collect center), minus
+Spark: workers are threads with their own devices, data slices come from
+the partitioned ``Dataset``, and the PS lives on localhost TCP (the same
+star topology; multi-host placement via ``jax.distributed`` puts the PS on
+process 0 and workers elsewhere with identical code).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..parallel.sync import make_window_fn
+from .servers import SocketParameterServer
+from .workers import ElasticWorker, PullCommitWorker, StalenessWorker
+
+_WORKER_CLASSES = {
+    "pull_commit": PullCommitWorker,
+    "staleness": StalenessWorker,
+    "elastic": ElasticWorker,
+}
+
+
+def run_async_training(trainer, dataset, fault_injector=None):
+    """Drive async-PS training for a DistributedTrainer subclass.
+
+    The trainer supplies: model/loss/optimizer, ``num_workers``,
+    ``communication_window``, epochs, the PS class (``_ps_factory``) and
+    the worker flavor (``_async_mode`` attribute).
+    """
+    loss_fn, optimizer = trainer._resolve()
+    window_fn = make_window_fn(trainer.model, loss_fn, optimizer)
+    mode = getattr(trainer, "_async_mode", "pull_commit")
+    worker_cls = _WORKER_CLASSES[mode]
+
+    xs, ys, _ = trainer._stage_data(dataset, trainer.communication_window)
+
+    center = jax.tree_util.tree_map(np.asarray,
+                                    trainer.model.init(trainer.seed))
+    ps = trainer._ps_factory()(center, num_workers=trainer.num_workers)
+    server = SocketParameterServer(ps, fault_injector=fault_injector).start()
+
+    devices = jax.devices()
+    workers = []
+    try:
+        for k in range(trainer.num_workers):
+            dev = devices[k % len(devices)]
+            kw = {}
+            if worker_cls is ElasticWorker:
+                kw["alpha"] = trainer.alpha
+            variables = jax.device_put(center, dev)
+            opt_state = jax.device_put(optimizer.init(center["params"]), dev)
+            rng = jax.device_put(
+                jax.random.PRNGKey(trainer.seed + 1 + k), dev)
+            w = worker_cls(k, window_fn, variables, opt_state, rng,
+                           "127.0.0.1", server.port, trainer.num_epoch,
+                           device=dev, **kw)
+            w.set_data(xs[k], ys[k])
+            workers.append(w)
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        for w in workers:
+            if w.error is not None:
+                raise RuntimeError(
+                    f"async worker {w.worker_id} failed") from w.error
+    finally:
+        server.stop()
+
+    # history: list per epoch of (workers, steps)
+    for e in range(trainer.num_epoch):
+        trainer.history.append(np.stack(
+            [w.losses[e].reshape(-1) for w in workers]))
+    return trainer._finish(ps.get_model())

@@ -1,0 +1,198 @@
+"""Parameter servers — parity with reference ``distkeras/parameter_servers.py``.
+
+``SocketParameterServer`` owns the listen/accept loop (one handler thread
+per connected worker, like the reference) and the mutex around commits; the
+subclasses implement the per-commit update rules:
+
+* ``DeltaParameterServer``   — center += delta (DOWNPOUR / AEASGD / EAMSGD)
+* ``ADAGParameterServer``    — center += delta / num_workers
+* ``DynSGDParameterServer``  — center += delta / (staleness + 1)
+
+The center variable is a NumPy pytree (the reference's was a Keras weight
+list).  A ``fault_injector`` hook can drop or delay commits — the test
+harness the reference never had (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from .networking import recv_msg, send_msg
+
+Tree = Any
+
+
+def _tree_map(f, *trees):
+    import jax
+    return jax.tree_util.tree_map(f, *trees)
+
+
+class ParameterServer:
+    """Base (reference ``ParameterServer``): holds the center variable and
+    the update counter."""
+
+    def __init__(self, center: Tree, num_workers: int = 1):
+        self.center = _tree_map(np.asarray, center)
+        self.num_workers = int(num_workers)
+        self.num_updates = 0
+        self.mutex = threading.Lock()
+
+    # -- update rule (subclass responsibility) ------------------------------
+    def apply_commit(self, delta: Tree, meta: dict) -> None:
+        raise NotImplementedError
+
+    def handle_commit(self, delta: Tree, meta: dict) -> None:
+        with self.mutex:
+            self.apply_commit(delta, meta)
+            self.num_updates += 1
+
+    def pull(self) -> tuple:
+        with self.mutex:
+            return self.center, self.num_updates
+
+    def get_model(self) -> Tree:
+        """Parity: reference ``ParameterServer.get_model``."""
+        with self.mutex:
+            return self.center
+
+
+class DeltaParameterServer(ParameterServer):
+    """center += delta.  Serves DOWNPOUR (delta = accumulated local update,
+    i.e. θ_after − θ_pulled) and the EASGD family (delta = elastic force E).
+    Parity: reference ``DeltaParameterServer``."""
+
+    def apply_commit(self, delta, meta):
+        self.center = _tree_map(lambda c, d: c + d, self.center, delta)
+
+
+class ADAGParameterServer(ParameterServer):
+    """center += delta / num_workers — the accumulated-gradient commit
+    normalized by worker count (parity: reference ``ADAGParameterServer``;
+    upstream README's recommended algorithm)."""
+
+    def apply_commit(self, delta, meta):
+        s = 1.0 / self.num_workers
+        self.center = _tree_map(lambda c, d: c + d * s, self.center, delta)
+
+
+class DynSGDParameterServer(ParameterServer):
+    """Staleness-aware commits (parity: reference ``DynSGDParameterServer``):
+    the worker reports the update counter it last pulled at; staleness =
+    current counter − reported; center += delta / (staleness + 1)."""
+
+    def apply_commit(self, delta, meta):
+        staleness = max(0, self.num_updates - int(meta.get("last_update", 0)))
+        s = 1.0 / (staleness + 1)
+        self.center = _tree_map(lambda c, d: c + d * s, self.center, delta)
+
+
+class SocketParameterServer:
+    """TCP front-end: accept loop + one handler thread per worker connection
+    (parity: reference ``SocketParameterServer.run``/``handle_connection``).
+
+    Protocol: each request is one framed msgpack map with an ``action`` key
+    (``pull`` / ``commit`` / ``stop``); every request gets a response.
+    """
+
+    def __init__(self, ps: ParameterServer, host: str = "127.0.0.1",
+                 port: int = 0,
+                 fault_injector: Optional[Callable[[str, dict], bool]] = None):
+        self.ps = ps
+        self.host = host
+        self.port = port
+        self.fault_injector = fault_injector
+        self._sock: Optional[socket.socket] = None
+        self._threads: list = []
+        self._conns: list = []
+        self._conn_lock = threading.Lock()
+        self._running = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "SocketParameterServer":
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self.port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(128)
+        self._running.set()
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="ps-accept")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._running.clear()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        # close live worker connections so handlers blocked in recv unblock
+        with self._conn_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in self._threads[1:]:
+            t.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- loops --------------------------------------------------------------
+    def _accept_loop(self):
+        while self._running.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # socket closed by stop()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conn_lock:
+                self._conns.append(conn)
+            t = threading.Thread(target=self._handle_connection, args=(conn,),
+                                 daemon=True, name="ps-conn")
+            t.start()
+            self._threads.append(t)
+
+    def _handle_connection(self, conn: socket.socket):
+        try:
+            while self._running.is_set():
+                try:
+                    msg = recv_msg(conn)
+                except (ConnectionError, OSError):
+                    return
+                action = msg.get("action")
+                if action == "pull":
+                    center, updates = self.ps.pull()
+                    send_msg(conn, {"center": center, "updates": updates})
+                elif action == "commit":
+                    dropped = bool(
+                        self.fault_injector and
+                        self.fault_injector("commit", msg))
+                    if not dropped:
+                        self.ps.handle_commit(msg["delta"], msg)
+                    send_msg(conn, {"ok": True, "dropped": dropped})
+                elif action == "stop":
+                    send_msg(conn, {"ok": True})
+                    return
+                else:
+                    send_msg(conn, {"ok": False,
+                                    "error": f"unknown action {action!r}"})
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._conn_lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)

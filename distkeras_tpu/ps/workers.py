@@ -1,0 +1,147 @@
+"""Async worker loops — parity with reference ``distkeras/workers.py``.
+
+Each worker owns a device, runs the jit-compiled window scan
+(``parallel.sync.make_window_fn``) on its partition, and talks to the
+parameter server at window boundaries:
+
+* ``PullCommitWorker``  — DOWNPOUR / ADAG (reference ``DOWNPOURWorker`` /
+  ``ADAGWorker``): pull center, train a window from it, commit the delta.
+* ``StalenessWorker``   — DynSGD (reference ``DynSGDWorker``): same, but the
+  commit carries the update counter seen at pull time so the server can
+  compute staleness.
+* ``ElasticWorker``     — AEASGD / EAMSGD (reference ``AEASGDWorker`` /
+  ``EAMSGDWorker``): the local model persists across windows; the elastic
+  force E = α(local − center) moves local toward center and is committed.
+
+Workers run as threads in this process (the reference's ran as Spark
+executor tasks): JAX compute releases the GIL, so windows genuinely overlap
+and commits interleave nondeterministically — real asynchrony, real
+staleness.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from .client import PSClient
+
+Tree = Any
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+def _host(tree):
+    return _tmap(np.asarray, tree)
+
+
+class AsyncWorker(threading.Thread):
+    """Base: epochs × windows loop over this worker's partition slice."""
+
+    def __init__(self, worker_id: int, window_fn: Callable,
+                 variables: Tree, opt_state: Tree, rng,
+                 host: str, port: int, num_epoch: int,
+                 device=None):
+        super().__init__(name=f"worker-{worker_id}", daemon=True)
+        self.worker_id = worker_id
+        self.window_fn = window_fn
+        self.variables = variables
+        self.opt_state = opt_state
+        self.rng = rng
+        self.ps_host = host
+        self.ps_port = port
+        self.num_epoch = num_epoch
+        self.device = device
+        self.losses: list = []          # one (n_windows, w) array per epoch
+        self.error: Optional[BaseException] = None
+        self.xs = self.ys = None        # (n_windows, w, batch, ...) numpy
+
+    def set_data(self, xs, ys):
+        self.xs, self.ys = xs, ys
+
+    def _put(self, tree):
+        if self.device is not None:
+            return _tmap(lambda x: jax.device_put(x, self.device), tree)
+        return tree
+
+    def run(self):
+        try:
+            client = PSClient(self.ps_host, self.ps_port, self.worker_id)
+            try:
+                self._train(client)
+            finally:
+                client.close()
+        except BaseException as e:  # surfaced by the runner after join()
+            self.error = e
+
+    def _train(self, client: PSClient):
+        for _ in range(self.num_epoch):
+            epoch_losses = []
+            for wi in range(self.xs.shape[0]):
+                wx = self._put(self.xs[wi])
+                wy = self._put(self.ys[wi])
+                losses = self._window(client, wx, wy)
+                epoch_losses.append(np.asarray(losses))
+            self.losses.append(np.stack(epoch_losses))
+
+    def _run_window(self, wx, wy):
+        self.variables, self.opt_state, self.rng, losses = self.window_fn(
+            self.variables, self.opt_state, self.rng, wx, wy)
+        return losses
+
+    def _window(self, client: PSClient, wx, wy):
+        raise NotImplementedError
+
+
+class PullCommitWorker(AsyncWorker):
+    """DOWNPOUR / ADAG: local model is replaced by the pulled center each
+    window; the commit is the accumulated local update Δ = θ_after −
+    θ_pulled (the server's rule decides scaling)."""
+
+    def _window(self, client, wx, wy):
+        center, _ = client.pull()
+        self.variables = self._put(center)
+        losses = self._run_window(wx, wy)
+        after = _host(self.variables)
+        delta = _tmap(lambda a, c: a - np.asarray(c), after, center)
+        client.commit(delta)
+        return losses
+
+
+class StalenessWorker(AsyncWorker):
+    """DynSGD: like PullCommitWorker but the commit reports the server
+    update counter observed at pull time (staleness bookkeeping)."""
+
+    def _window(self, client, wx, wy):
+        center, seen_updates = client.pull()
+        self.variables = self._put(center)
+        losses = self._run_window(wx, wy)
+        after = _host(self.variables)
+        delta = _tmap(lambda a, c: a - np.asarray(c), after, center)
+        client.commit(delta, last_update=seen_updates)
+        return losses
+
+
+class ElasticWorker(AsyncWorker):
+    """AEASGD / EAMSGD: local model persists (exploration); every window the
+    elastic force E = α(local − center) is applied locally and committed."""
+
+    def __init__(self, *args, alpha: float = 0.05, **kw):
+        super().__init__(*args, **kw)
+        self.alpha = float(alpha)
+
+    def _window(self, client, wx, wy):
+        losses = self._run_window(wx, wy)
+        center, _ = client.pull()
+        local = _host(self.variables)
+        elastic = _tmap(lambda l, c: self.alpha * (l - np.asarray(c)),
+                        local, center)
+        self.variables = self._put(
+            _tmap(lambda l, e: l - e, local, elastic))
+        client.commit(elastic)
+        return losses

@@ -23,6 +23,7 @@ Under the hood nothing resembles the reference's Spark + socket-PS stack:
 from __future__ import annotations
 
 import time
+import warnings
 from typing import Any, Optional, Sequence
 
 import jax
@@ -37,7 +38,7 @@ from .ops.losses import get_loss, probs_loss_variant
 from .ops.optimizers import get_optimizer
 from .parallel import mesh as mesh_lib
 from .parallel.sync import (AdagSync, DownpourSync, DynSgdSync, EasgdSync,
-                            NoCommSync, SyncEngine, tmap)
+                            NoCommSync, SyncEngine, make_window_fn, tmap)
 from .utils import serde
 
 
@@ -46,12 +47,10 @@ def _ends_in_softmax(model: Model) -> bool:
     crossentropy on probabilities (Keras semantics).  Detect that so the
     loss can use the numerically-stable on-probs variant."""
     layer = model.layer
-    if isinstance(layer, Sequential) and layer.layers:
-        last = layer.layers[-1]
-        if isinstance(last, Activation) and last.activation == "softmax":
-            return True
-        if isinstance(last, Dense) and last.activation == "softmax":
-            return True
+    while isinstance(layer, Sequential) and layer.layers:
+        layer = layer.layers[-1]
+    if isinstance(layer, Activation) and layer.activation == "softmax":
+        return True
     if isinstance(layer, Dense) and layer.activation == "softmax":
         return True
     return False
@@ -135,10 +134,7 @@ class SingleTrainer(Trainer):
         if shuffle:
             dataset = dataset.shuffle(self.seed)
         loss_fn, optimizer = self._resolve()
-        engine = SyncEngine(self.model, loss_fn, optimizer, NoCommSync(),
-                            num_workers=1, window=1,
-                            mesh=mesh_lib.make_mesh(1))
-        run = engine.single_epoch_fn()
+        run = make_window_fn(self.model, loss_fn, optimizer)
 
         ds = dataset.coalesce(1)
         stacked, steps = ds.stacked([self.features_col, self.label_col],
@@ -205,6 +201,13 @@ class DistributedTrainer(Trainer):
                 f"communication_window {window} exceeds the {steps} "
                 f"steps available per worker (decrease window/batch_size "
                 f"or add data)")
+        dropped = steps - n_windows * window
+        if dropped:
+            warnings.warn(
+                f"{dropped} of {steps} per-worker batches don't fill a "
+                f"communication_window of {window} and are dropped each "
+                f"epoch (static shapes require whole windows); pick a "
+                f"window dividing {steps} to use all data", stacklevel=3)
 
         def shape_windows(a):
             a = a[:, : n_windows * window]
@@ -350,6 +353,7 @@ class DOWNPOUR(AsynchronousDistributedTrainer):
     """DOWNPOUR SGD (Dean et al. 2012; reference ``DOWNPOUR`` trainer)."""
 
     _default_window = 5
+    _async_mode = "pull_commit"
 
     def _sync_algorithm(self):
         return DownpourSync()
@@ -366,6 +370,7 @@ class ADAG(AsynchronousDistributedTrainer):
     configuration."""
 
     _default_window = 12
+    _async_mode = "pull_commit"
 
     def _sync_algorithm(self):
         return AdagSync()
@@ -380,6 +385,7 @@ class DynSGD(AsynchronousDistributedTrainer):
     ``DynSGDParameterServer``): commits scaled by 1/(staleness+1)."""
 
     _default_window = 5
+    _async_mode = "staleness"
 
     def _sync_algorithm(self):
         return DynSgdSync()
@@ -395,6 +401,7 @@ class AEASGD(AsynchronousDistributedTrainer):
     elastic alpha is ``rho * learning_rate`` as in the reference."""
 
     _default_window = 32
+    _async_mode = "elastic"
 
     def __init__(self, keras_model, worker_optimizer="sgd",
                  loss="categorical_crossentropy", num_workers: int = 2,

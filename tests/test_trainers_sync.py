@@ -97,16 +97,13 @@ def test_downpour_equals_single_with_one_worker(ds):
 # -- pure communication-rule math (reference PS update rules as pure fns) --
 
 def test_comm_rule_math():
-    import jax
     from distkeras_tpu.parallel.mesh import make_mesh, shard_map
+    from distkeras_tpu.parallel.sync import _shard_map_kw
     from jax.sharding import PartitionSpec as P
     import jax.numpy as jnp
-    import inspect
 
     mesh = make_mesh(8)
-    kw = ({"check_vma": False}
-          if "check_vma" in inspect.signature(shard_map).parameters
-          else {"check_rep": False})
+    kw = _shard_map_kw()
     center = jnp.zeros((4,))
     local = jnp.arange(32, dtype=jnp.float32).reshape(8, 4)
 
