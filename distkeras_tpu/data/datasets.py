@@ -1,0 +1,151 @@
+"""Benchmark dataset loaders — MNIST / CIFAR-10 / IMDB / ImageNet-subset.
+
+The reference reads its data from CSV/parquet on HDFS via Spark (the MNIST
+notebook loads a CSV of flat pixels).  Here loaders return our partitioned
+``Dataset`` directly.  In an air-gapped environment the real archives may
+be absent: each loader first tries the local Keras cache
+(``~/.keras/datasets``), then falls back to a **deterministic synthetic
+surrogate** with the same shapes/dtypes and a learnable class structure
+(class-template + noise), flagged via ``meta['synthetic']`` — throughput
+benchmarks are unaffected and convergence checks remain meaningful.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .dataset import Dataset
+
+KERAS_CACHE = os.path.expanduser("~/.keras/datasets")
+
+
+def _synthetic_images(n: int, shape: Tuple[int, ...], num_classes: int,
+                      seed: int, noise: float = 0.35):
+    """Class-template images: templates are smooth random fields; samples =
+    template[label] + gaussian noise.  Linearly separable enough to train
+    on, hard enough that accuracy tracks real optimization progress."""
+    rng = np.random.default_rng(seed)
+    templates = rng.normal(0.5, 0.25, size=(num_classes, *shape)).astype(np.float32)
+    labels = rng.integers(0, num_classes, size=n)
+    x = templates[labels] + rng.normal(0, noise, size=(n, *shape)).astype(np.float32)
+    return np.clip(x, 0.0, 1.0).astype(np.float32), labels.astype(np.int64)
+
+
+def load_mnist(n_train: Optional[int] = None, flat: bool = True,
+               seed: int = 0) -> Tuple[Dataset, Dataset, dict]:
+    """(train, test, meta).  Columns: ``features`` (784 flat or 28×28×1),
+    ``label`` int.  Pixels already scaled to [0,1] (the reference pipeline
+    does this with ``MinMaxTransformer``; loaders pre-scale so benchmarks
+    measure training, not preprocessing)."""
+    path = os.path.join(KERAS_CACHE, "mnist.npz")
+    meta = {"num_classes": 10, "synthetic": True}
+    if os.path.exists(path):
+        with np.load(path) as d:
+            xtr, ytr = d["x_train"], d["y_train"]
+            xte, yte = d["x_test"], d["y_test"]
+        xtr = (xtr / 255.0).astype(np.float32)
+        xte = (xte / 255.0).astype(np.float32)
+        meta["synthetic"] = False
+    else:
+        xtr, ytr = _synthetic_images(n_train or 60000, (28, 28), 10, seed)
+        xte, yte = _synthetic_images(10000, (28, 28), 10, seed + 1)
+    if n_train:
+        xtr, ytr = xtr[:n_train], ytr[:n_train]
+    if flat:
+        xtr = xtr.reshape(len(xtr), 784)
+        xte = xte.reshape(len(xte), 784)
+    else:
+        xtr = xtr.reshape(len(xtr), 28, 28, 1)
+        xte = xte.reshape(len(xte), 28, 28, 1)
+    return (Dataset({"features": xtr, "label": ytr}),
+            Dataset({"features": xte, "label": yte}), meta)
+
+
+def load_cifar10(n_train: Optional[int] = None, seed: int = 0
+                 ) -> Tuple[Dataset, Dataset, dict]:
+    """(train, test, meta).  ``features`` 32×32×3 float32 in [0,1]."""
+    path = os.path.join(KERAS_CACHE, "cifar-10-batches-py")
+    meta = {"num_classes": 10, "synthetic": True}
+    if os.path.isdir(path):
+        import pickle
+        xs, ys = [], []
+        for i in range(1, 6):
+            with open(os.path.join(path, f"data_batch_{i}"), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(d[b"data"])
+            ys.extend(d[b"labels"])
+        xtr = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        xtr = (xtr / 255.0).astype(np.float32)
+        ytr = np.asarray(ys, dtype=np.int64)
+        with open(os.path.join(path, "test_batch"), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        xte = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        xte = (xte / 255.0).astype(np.float32)
+        yte = np.asarray(d[b"labels"], dtype=np.int64)
+        meta["synthetic"] = False
+    else:
+        xtr, ytr = _synthetic_images(n_train or 50000, (32, 32, 3), 10, seed)
+        xte, yte = _synthetic_images(10000, (32, 32, 3), 10, seed + 1)
+    if n_train:
+        xtr, ytr = xtr[:n_train], ytr[:n_train]
+    return (Dataset({"features": xtr, "label": ytr}),
+            Dataset({"features": xte, "label": yte}), meta)
+
+
+def load_imdb(n_train: Optional[int] = None, seq_len: int = 200,
+              vocab_size: int = 20000, seed: int = 0
+              ) -> Tuple[Dataset, Dataset, dict]:
+    """(train, test, meta).  ``features`` int32 token ids padded/truncated
+    to ``seq_len``; ``label`` in {0,1}.  Synthetic surrogate: two Zipfian
+    token distributions with class-indicative marker tokens."""
+    path = os.path.join(KERAS_CACHE, "imdb.npz")
+    meta = {"num_classes": 2, "synthetic": True, "seq_len": seq_len}
+
+    def pad(seqs):
+        out = np.zeros((len(seqs), seq_len), dtype=np.int32)
+        for i, s in enumerate(seqs):
+            s = np.asarray(s[:seq_len], dtype=np.int32) % vocab_size
+            out[i, : len(s)] = s
+        return out
+
+    if os.path.exists(path):
+        with np.load(path, allow_pickle=True) as d:
+            xtr, ytr = pad(d["x_train"]), d["y_train"].astype(np.int64)
+            xte, yte = pad(d["x_test"]), d["y_test"].astype(np.int64)
+        meta["synthetic"] = False
+    else:
+        def synth(n, s):
+            rng = np.random.default_rng(s)
+            labels = rng.integers(0, 2, size=n)
+            # Zipf-ish body + class-marker tokens sprinkled in
+            body = rng.zipf(1.3, size=(n, seq_len)).astype(np.int64)
+            body = np.clip(body, 1, vocab_size - 1)
+            markers = np.where(labels[:, None] == 1, 17, 23)
+            mask = rng.random((n, seq_len)) < 0.08
+            x = np.where(mask, markers, body).astype(np.int32)
+            return x, labels.astype(np.int64)
+        xtr, ytr = synth(n_train or 25000, seed)
+        xte, yte = synth(5000, seed + 1)
+    if n_train:
+        xtr, ytr = xtr[:n_train], ytr[:n_train]
+    return (Dataset({"features": xtr, "label": ytr}),
+            Dataset({"features": xte, "label": yte}), meta)
+
+
+def load_imagenet_subset(n_train: int = 5000, num_classes: int = 100,
+                         image_size: int = 224, seed: int = 0
+                         ) -> Tuple[Dataset, Dataset, dict]:
+    """(train, test, meta) for the DynSGD ResNet-50 config.  Always
+    synthetic in this environment (no ImageNet on disk): ``features``
+    ``image_size²×3`` float32."""
+    meta = {"num_classes": num_classes, "synthetic": True}
+    xtr, ytr = _synthetic_images(n_train, (image_size, image_size, 3),
+                                 num_classes, seed)
+    xte, yte = _synthetic_images(max(n_train // 10, num_classes),
+                                 (image_size, image_size, 3), num_classes,
+                                 seed + 1)
+    return (Dataset({"features": xtr, "label": ytr}),
+            Dataset({"features": xte, "label": yte}), meta)

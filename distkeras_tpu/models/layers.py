@@ -468,6 +468,72 @@ class LSTM(Layer):
 # ---------------------------------------------------------------------------
 
 @register
+class Residual(Layer):
+    """Residual block: ``y = act(inner(x) + shortcut(x))``.
+
+    The combinator the reference never needed (its era's models were plain
+    Sequential stacks) but ResNet-20/50 (BASELINE.json configs) require.
+    ``shortcut`` defaults to identity; pass a layer (e.g. a 1×1 strided
+    Conv2D) when shapes change.  XLA fuses the add into the adjacent convs.
+    """
+
+    def __init__(self, inner: "Layer", shortcut: Optional["Layer"] = None,
+                 activation=None):
+        self.inner = inner
+        self.shortcut = shortcut
+        self.activation = activation
+        self._act = get_activation(activation)
+
+    def init(self, rng, in_shape):
+        r1, r2 = jax.random.split(rng)
+        p_in, s_in, out_shape = self.inner.init(r1, in_shape)
+        params = {"inner": p_in}
+        state = {"inner": s_in}
+        if self.shortcut is not None:
+            p_sc, s_sc, sc_shape = self.shortcut.init(r2, in_shape)
+            if tuple(sc_shape) != tuple(out_shape):
+                raise ValueError(
+                    f"shortcut shape {sc_shape} != inner shape {out_shape}")
+            params["shortcut"] = p_sc
+            state["shortcut"] = s_sc
+        elif tuple(out_shape) != tuple(in_shape):
+            raise ValueError(
+                f"identity shortcut needs matching shapes, got {in_shape} -> "
+                f"{out_shape}; pass a projection shortcut")
+        return params, state, out_shape
+
+    def out_shape(self, in_shape):
+        return self.inner.out_shape(in_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        r1 = r2 = None
+        if rng is not None:
+            r1, r2 = jax.random.split(rng)
+        y, new_inner = self.inner.apply(params["inner"], state["inner"], x,
+                                        train=train, rng=r1)
+        new_state = {"inner": new_inner}
+        if self.shortcut is not None:
+            sc, new_sc = self.shortcut.apply(params["shortcut"],
+                                             state["shortcut"], x,
+                                             train=train, rng=r2)
+            new_state["shortcut"] = new_sc
+        else:
+            sc = x
+        return self._act(y + sc), new_state
+
+    def get_config(self):
+        return {"inner": self.inner.config(),
+                "shortcut": self.shortcut.config() if self.shortcut else None,
+                "activation": activation_config(self.activation)}
+
+    @classmethod
+    def from_config(cls, cfg):
+        return cls(layer_from_config(cfg["inner"]),
+                   layer_from_config(cfg["shortcut"]) if cfg["shortcut"] else None,
+                   activation=cfg.get("activation"))
+
+
+@register
 class Sequential(Layer):
     """Keras-Sequential-style composition; the standard model container.
 

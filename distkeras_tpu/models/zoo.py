@@ -1,0 +1,165 @@
+"""Model zoo — the five benchmark model families from BASELINE.json.
+
+The reference defines its models ad hoc in notebooks (``examples/
+mnist.ipynb`` builds a Keras Sequential MLP/convnet inline; the workflow
+notebook reuses them).  We ship them as constructors so trainers, tests and
+benchmarks share one definition:
+
+1. ``mlp_mnist``       — SingleTrainer MLP on MNIST (the 99%-acc anchor)
+2. ``convnet_cifar10`` — ADAG ConvNet on CIFAR-10
+3. ``resnet20``        — DOWNPOUR ResNet-20 on CIFAR-10 (He et al. 2015,
+                         the CIFAR variant: 3 stages × 3 blocks, 16/32/64)
+4. ``lstm_imdb``       — AEASGD/EAMSGD LSTM sentiment on IMDB
+5. ``resnet50``        — DynSGD ResNet-50 on ImageNet-subset (bottleneck
+                         blocks, 4 stages × [3,4,6,3])
+
+All are NHWC / channels-last, end in softmax (the reference's Keras
+convention — trainers swap in the on-probs loss), and lower to MXU-friendly
+convs/matmuls with static shapes.
+"""
+
+from __future__ import annotations
+
+from .layers import (Activation, AvgPool2D, BatchNorm, Conv2D, Dense, Dropout,
+                     Embedding, Flatten, GlobalAvgPool2D, LSTM, MaxPool2D,
+                     Residual, Sequential)
+from .model import Model
+
+
+def mlp_mnist(hidden: int = 500, num_classes: int = 10) -> Model:
+    """MLP for flat 784-dim MNIST (reference ``examples/mnist.ipynb``
+    architecture scale: Dense(500) stacks + softmax head)."""
+    return Model(Sequential([
+        Dense(hidden, "relu"),
+        Dense(hidden, "relu"),
+        Dense(num_classes, "softmax"),
+    ]), input_shape=(784,), name="mlp_mnist")
+
+
+def convnet_mnist(num_classes: int = 10) -> Model:
+    """Small convnet for 28×28×1 MNIST (the reference notebook's convnet
+    variant: conv-pool-conv-pool-dense)."""
+    return Model(Sequential([
+        Conv2D(32, 3, activation="relu"),
+        MaxPool2D(2),
+        Conv2D(64, 3, activation="relu"),
+        MaxPool2D(2),
+        Flatten(),
+        Dense(128, "relu"),
+        Dense(num_classes, "softmax"),
+    ]), input_shape=(28, 28, 1), name="convnet_mnist")
+
+
+def convnet_cifar10(num_classes: int = 10) -> Model:
+    """VGG-ish ConvNet for 32×32×3 CIFAR-10 (ADAG benchmark config)."""
+    return Model(Sequential([
+        Conv2D(32, 3, activation="relu"),
+        Conv2D(32, 3, activation="relu"),
+        MaxPool2D(2),
+        Conv2D(64, 3, activation="relu"),
+        Conv2D(64, 3, activation="relu"),
+        MaxPool2D(2),
+        Flatten(),
+        Dense(256, "relu"),
+        Dropout(0.5),
+        Dense(num_classes, "softmax"),
+    ]), input_shape=(32, 32, 3), name="convnet_cifar10")
+
+
+def _basic_block(filters: int, stride: int = 1, in_filters: int = None):
+    """ResNet v1 basic block: conv-bn-relu-conv-bn (+shortcut) -relu."""
+    inner = Sequential([
+        Conv2D(filters, 3, strides=stride, use_bias=False),
+        BatchNorm(),
+        Activation("relu"),
+        Conv2D(filters, 3, use_bias=False),
+        BatchNorm(),
+    ])
+    shortcut = None
+    if stride != 1 or (in_filters is not None and in_filters != filters):
+        shortcut = Sequential([
+            Conv2D(filters, 1, strides=stride, use_bias=False),
+            BatchNorm(),
+        ])
+    return Residual(inner, shortcut, activation="relu")
+
+
+def resnet20(num_classes: int = 10) -> Model:
+    """ResNet-20 for CIFAR-10 (He et al. 2015 §4.2: n=3 → 6n+2=20 layers,
+    widths 16/32/64).  The DOWNPOUR benchmark config and the headline
+    samples/sec/chip model."""
+    layers = [Conv2D(16, 3, use_bias=False), BatchNorm(), Activation("relu")]
+    widths = [16, 32, 64]
+    in_f = 16
+    for si, f in enumerate(widths):
+        for bi in range(3):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            layers.append(_basic_block(f, stride, in_f))
+            in_f = f
+    layers += [GlobalAvgPool2D(), Dense(num_classes, "softmax")]
+    return Model(Sequential(layers), input_shape=(32, 32, 3), name="resnet20")
+
+
+def _bottleneck(filters: int, stride: int = 1, in_filters: int = None):
+    """ResNet v1.5 bottleneck: 1×1 reduce, 3×3 (strided), 1×1 expand ×4."""
+    out_f = filters * 4
+    inner = Sequential([
+        Conv2D(filters, 1, use_bias=False),
+        BatchNorm(),
+        Activation("relu"),
+        Conv2D(filters, 3, strides=stride, use_bias=False),
+        BatchNorm(),
+        Activation("relu"),
+        Conv2D(out_f, 1, use_bias=False),
+        BatchNorm(),
+    ])
+    shortcut = None
+    if stride != 1 or (in_filters is not None and in_filters != out_f):
+        shortcut = Sequential([
+            Conv2D(out_f, 1, strides=stride, use_bias=False),
+            BatchNorm(),
+        ])
+    return Residual(inner, shortcut, activation="relu")
+
+
+def resnet50(num_classes: int = 1000, input_size: int = 224) -> Model:
+    """ResNet-50 (DynSGD / ImageNet-subset benchmark config): stem +
+    [3,4,6,3] bottleneck stages, widths 64/128/256/512."""
+    layers = [
+        Conv2D(64, 7, strides=2, use_bias=False),
+        BatchNorm(),
+        Activation("relu"),
+        MaxPool2D(3, strides=2, padding="SAME"),
+    ]
+    in_f = 64
+    for si, (f, blocks) in enumerate(zip([64, 128, 256, 512], [3, 4, 6, 3])):
+        for bi in range(blocks):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            layers.append(_bottleneck(f, stride, in_f))
+            in_f = f * 4
+    layers += [GlobalAvgPool2D(), Dense(num_classes, "softmax")]
+    return Model(Sequential(layers), input_shape=(input_size, input_size, 3),
+                 name="resnet50")
+
+
+def lstm_imdb(vocab_size: int = 20000, embed_dim: int = 128,
+              lstm_units: int = 128, seq_len: int = 200) -> Model:
+    """LSTM sentiment classifier for IMDB (AEASGD/EAMSGD benchmark config):
+    embed → LSTM → dense sigmoid.  Sequences are padded/bucketed to
+    ``seq_len`` for static shapes (XLA recompilation trap, SURVEY.md §7)."""
+    return Model(Sequential([
+        Embedding(vocab_size, embed_dim),
+        LSTM(lstm_units),
+        Dropout(0.5),
+        Dense(1, "sigmoid"),
+    ]), input_shape=(seq_len,), name="lstm_imdb")
+
+
+ZOO = {
+    "mlp_mnist": mlp_mnist,
+    "convnet_mnist": convnet_mnist,
+    "convnet_cifar10": convnet_cifar10,
+    "resnet20": resnet20,
+    "resnet50": resnet50,
+    "lstm_imdb": lstm_imdb,
+}

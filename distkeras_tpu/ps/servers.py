@@ -21,14 +21,10 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from ..parallel.sync import tmap as _tree_map
 from .networking import recv_msg, send_msg
 
 Tree = Any
-
-
-def _tree_map(f, *trees):
-    import jax
-    return jax.tree_util.tree_map(f, *trees)
 
 
 class ParameterServer:

@@ -27,13 +27,10 @@ from typing import Any, Callable, Optional
 import jax
 import numpy as np
 
+from ..parallel.sync import tmap as _tmap
 from .client import PSClient
 
 Tree = Any
-
-
-def _tmap(f, *trees):
-    return jax.tree_util.tree_map(f, *trees)
 
 
 def _host(tree):

@@ -42,16 +42,16 @@ from .parallel.sync import (AdagSync, DownpourSync, DynSgdSync, EasgdSync,
 from .utils import serde
 
 
-def _ends_in_softmax(model: Model) -> bool:
-    """Reference models end in a softmax layer and train with categorical
-    crossentropy on probabilities (Keras semantics).  Detect that so the
-    loss can use the numerically-stable on-probs variant."""
+def _ends_in_prob_activation(model: Model) -> bool:
+    """Reference models end in a softmax (or sigmoid, for binary heads)
+    layer and train with crossentropy on probabilities (Keras semantics).
+    Detect that so the loss can use the numerically-stable on-probs
+    variant."""
     layer = model.layer
     while isinstance(layer, Sequential) and layer.layers:
         layer = layer.layers[-1]
-    if isinstance(layer, Activation) and layer.activation == "softmax":
-        return True
-    if isinstance(layer, Dense) and layer.activation == "softmax":
+    if isinstance(layer, (Activation, Dense)) and \
+            layer.activation in ("softmax", "sigmoid"):
         return True
     return False
 
@@ -103,7 +103,7 @@ class Trainer:
     # -- shared plumbing ----------------------------------------------------
     def _resolve(self):
         loss_fn = get_loss(self.loss)
-        if isinstance(self.loss, str) and _ends_in_softmax(self.model):
+        if isinstance(self.loss, str) and _ends_in_prob_activation(self.model):
             loss_fn = probs_loss_variant(self.loss) or loss_fn
         optimizer = get_optimizer(self.worker_optimizer, self.learning_rate)
         return loss_fn, optimizer
