@@ -26,3 +26,8 @@ from .trainers import (
 )
 from .predictors import ModelPredictor, Predictor
 from .evaluators import AccuracyEvaluator, Evaluator, F1Evaluator, LossEvaluator
+from .job_deployment import Job, Punchcard
+from .models import zoo
+from .data import datasets
+from .utils.checkpoint import CheckpointManager
+from .utils.metrics import MetricsLogger, profile_trace

@@ -23,14 +23,20 @@ KERAS_CACHE = os.path.expanduser("~/.keras/datasets")
 
 
 def _synthetic_images(n: int, shape: Tuple[int, ...], num_classes: int,
-                      seed: int, noise: float = 0.35):
+                      seed: int, noise: float = 0.35, split_seed: int = 0):
     """Class-template images: templates are smooth random fields; samples =
     template[label] + gaussian noise.  Linearly separable enough to train
-    on, hard enough that accuracy tracks real optimization progress."""
+    on, hard enough that accuracy tracks real optimization progress.
+
+    ``seed`` fixes the class templates (MUST be shared by the train and
+    test splits of one dataset, or test accuracy is chance);
+    ``split_seed`` varies the sampled labels/noise per split.
+    """
     rng = np.random.default_rng(seed)
     templates = rng.normal(0.5, 0.25, size=(num_classes, *shape)).astype(np.float32)
-    labels = rng.integers(0, num_classes, size=n)
-    x = templates[labels] + rng.normal(0, noise, size=(n, *shape)).astype(np.float32)
+    srng = np.random.default_rng((seed, split_seed))
+    labels = srng.integers(0, num_classes, size=n)
+    x = templates[labels] + srng.normal(0, noise, size=(n, *shape)).astype(np.float32)
     return np.clip(x, 0.0, 1.0).astype(np.float32), labels.astype(np.int64)
 
 
@@ -50,8 +56,9 @@ def load_mnist(n_train: Optional[int] = None, flat: bool = True,
         xte = (xte / 255.0).astype(np.float32)
         meta["synthetic"] = False
     else:
-        xtr, ytr = _synthetic_images(n_train or 60000, (28, 28), 10, seed)
-        xte, yte = _synthetic_images(10000, (28, 28), 10, seed + 1)
+        xtr, ytr = _synthetic_images(n_train or 60000, (28, 28), 10, seed,
+                                     split_seed=0)
+        xte, yte = _synthetic_images(10000, (28, 28), 10, seed, split_seed=1)
     if n_train:
         xtr, ytr = xtr[:n_train], ytr[:n_train]
     if flat:
@@ -87,8 +94,10 @@ def load_cifar10(n_train: Optional[int] = None, seed: int = 0
         yte = np.asarray(d[b"labels"], dtype=np.int64)
         meta["synthetic"] = False
     else:
-        xtr, ytr = _synthetic_images(n_train or 50000, (32, 32, 3), 10, seed)
-        xte, yte = _synthetic_images(10000, (32, 32, 3), 10, seed + 1)
+        xtr, ytr = _synthetic_images(n_train or 50000, (32, 32, 3), 10, seed,
+                                     split_seed=0)
+        xte, yte = _synthetic_images(10000, (32, 32, 3), 10, seed,
+                                     split_seed=1)
     if n_train:
         xtr, ytr = xtr[:n_train], ytr[:n_train]
     return (Dataset({"features": xtr, "label": ytr}),
@@ -104,10 +113,13 @@ def load_imdb(n_train: Optional[int] = None, seq_len: int = 200,
     path = os.path.join(KERAS_CACHE, "imdb.npz")
     meta = {"num_classes": 2, "synthetic": True, "seq_len": seq_len}
 
+    OOV = 2  # Keras imdb convention: oov_char=2
+
     def pad(seqs):
         out = np.zeros((len(seqs), seq_len), dtype=np.int32)
         for i, s in enumerate(seqs):
-            s = np.asarray(s[:seq_len], dtype=np.int32) % vocab_size
+            s = np.asarray(s[:seq_len], dtype=np.int32)
+            s = np.where(s < vocab_size, s, OOV)
             out[i, : len(s)] = s
         return out
 
@@ -143,9 +155,9 @@ def load_imagenet_subset(n_train: int = 5000, num_classes: int = 100,
     ``image_size²×3`` float32."""
     meta = {"num_classes": num_classes, "synthetic": True}
     xtr, ytr = _synthetic_images(n_train, (image_size, image_size, 3),
-                                 num_classes, seed)
+                                 num_classes, seed, split_seed=0)
     xte, yte = _synthetic_images(max(n_train // 10, num_classes),
                                  (image_size, image_size, 3), num_classes,
-                                 seed + 1)
+                                 seed, split_seed=1)
     return (Dataset({"features": xtr, "label": ytr}),
             Dataset({"features": xte, "label": yte}), meta)

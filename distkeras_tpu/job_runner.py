@@ -1,0 +1,65 @@
+"""Job-package executor: ``python -m distkeras_tpu.job_runner PKG OUT``.
+
+The remote half of ``job_deployment`` (the reference's ``spark-submit``\\ ed
+script): load the package, rebuild model + trainer + dataset, train, write
+the trained model blob (+ history) to OUT.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+from . import trainers as trainers_mod
+from .data import datasets as datasets_mod
+from .data.dataset import Dataset
+from .models.model import Model
+from .utils import serde
+
+
+def _load_dataset(spec: dict) -> Dataset:
+    if "loader" in spec:
+        loader = getattr(datasets_mod, spec["loader"])
+        train, _test, _meta = loader(**spec.get("kwargs", {}))
+        return train
+    if "npz" in spec:
+        with np.load(spec["npz"]) as d:
+            return Dataset({k: d[k] for k in d.files})
+    raise ValueError(f"unrecognized dataset spec {spec!r}")
+
+
+def run_package(pkg_path: str, out_path: str) -> None:
+    with open(pkg_path, "rb") as f:
+        pkg = serde.tree_from_bytes(f.read())
+
+    model = Model.from_config(json.loads(pkg["model_config"]))
+    cls = getattr(trainers_mod, pkg["trainer"]["class"])
+    trainer = cls(model, **pkg["trainer"].get("kwargs", {}))
+    ds = _load_dataset(pkg["dataset"])
+    trained = trainer.train(ds, shuffle=pkg.get("shuffle", False))
+    if isinstance(trained, list):  # EnsembleTrainer returns a list
+        trained = trained[0]
+
+    payload = {
+        "model": serde.serialize_model(trained, trained.variables),
+        "history": [np.asarray(h) for h in trainer.get_history()],
+        "training_time": trainer.get_training_time(),
+    }
+    with open(out_path, "wb") as f:
+        f.write(serde.tree_to_bytes(payload))
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 2:
+        print("usage: python -m distkeras_tpu.job_runner PKG OUT",
+              file=sys.stderr)
+        return 2
+    run_package(argv[0], argv[1])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,7 +40,24 @@ def run_async_training(trainer, dataset, fault_injector=None):
 
     center = jax.tree_util.tree_map(np.asarray,
                                     trainer.model.init(trainer.seed))
-    ps = trainer._ps_factory()(center, num_workers=trainer.num_workers)
+    ps_kwargs = {}
+    ckpt = trainer._ckpt_manager()
+    if ckpt is not None:
+        # checkpoint the center roughly once per worker round of commits
+        ps_kwargs = {"checkpoint_manager": ckpt,
+                     "checkpoint_every": trainer.num_workers}
+    ps = trainer._ps_factory()(center, num_workers=trainer.num_workers,
+                               **ps_kwargs)
+    num_epoch = trainer.num_epoch
+    if ckpt is not None and getattr(trainer, "_resume", False):
+        if ps.restore(ckpt):
+            # true async training has no global epoch barrier; approximate
+            # completed epochs from the commit counter (workers × windows
+            # commits per epoch) and train only the remainder
+            commits_per_epoch = trainer.num_workers * xs.shape[1]
+            done = ps.num_updates // max(1, commits_per_epoch)
+            num_epoch = max(0, trainer.num_epoch - done)
+            center = ps.get_model()  # workers start from the restored center
     server = SocketParameterServer(ps, fault_injector=fault_injector).start()
 
     devices = jax.devices()
@@ -56,7 +73,7 @@ def run_async_training(trainer, dataset, fault_injector=None):
             rng = jax.device_put(
                 jax.random.PRNGKey(trainer.seed + 1 + k), dev)
             w = worker_cls(k, window_fn, variables, opt_state, rng,
-                           "127.0.0.1", server.port, trainer.num_epoch,
+                           "127.0.0.1", server.port, num_epoch,
                            device=dev, **kw)
             w.set_data(xs[k], ys[k])
             workers.append(w)
@@ -72,7 +89,7 @@ def run_async_training(trainer, dataset, fault_injector=None):
         server.stop()
 
     # history: list per epoch of (workers, steps)
-    for e in range(trainer.num_epoch):
+    for e in range(num_epoch):
         trainer.history.append(np.stack(
             [w.losses[e].reshape(-1) for w in workers]))
     return trainer._finish(ps.get_model())

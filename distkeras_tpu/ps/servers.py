@@ -29,22 +29,46 @@ Tree = Any
 
 class ParameterServer:
     """Base (reference ``ParameterServer``): holds the center variable and
-    the update counter."""
+    the update counter.  Optionally checkpoints the center every
+    ``checkpoint_every`` commits (SURVEY.md §5.4 — persistence the
+    reference lacked)."""
 
-    def __init__(self, center: Tree, num_workers: int = 1):
+    def __init__(self, center: Tree, num_workers: int = 1,
+                 checkpoint_manager=None, checkpoint_every: int = 0):
         self.center = _tree_map(np.asarray, center)
         self.num_workers = int(num_workers)
         self.num_updates = 0
         self.mutex = threading.Lock()
+        self.checkpoint_manager = checkpoint_manager
+        self.checkpoint_every = int(checkpoint_every)
 
     # -- update rule (subclass responsibility) ------------------------------
     def apply_commit(self, delta: Tree, meta: dict) -> None:
         raise NotImplementedError
 
     def handle_commit(self, delta: Tree, meta: dict) -> None:
+        snapshot = None
         with self.mutex:
             self.apply_commit(delta, meta)
             self.num_updates += 1
+            if (self.checkpoint_manager is not None and self.checkpoint_every
+                    and self.num_updates % self.checkpoint_every == 0):
+                # capture the reference only; commits replace (never mutate)
+                # the center tree, so serializing outside the lock is safe
+                # and pulls/commits don't stall on the disk write
+                snapshot = (self.center, self.num_updates)
+        if snapshot is not None:
+            center, n = snapshot
+            self.checkpoint_manager.save(n, center, {"num_updates": n})
+
+    def restore(self, checkpoint_manager) -> bool:
+        """Load the latest center checkpoint; returns True if restored."""
+        if checkpoint_manager.latest_step() is None:
+            return False
+        with self.mutex:
+            self.center, meta = checkpoint_manager.restore(self.center)
+            self.num_updates = int(meta.get("num_updates", 0))
+        return True
 
     def pull(self) -> tuple:
         with self.mutex:

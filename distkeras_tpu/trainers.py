@@ -40,6 +40,8 @@ from .parallel import mesh as mesh_lib
 from .parallel.sync import (AdagSync, DownpourSync, DynSgdSync, EasgdSync,
                             NoCommSync, SyncEngine, make_window_fn, tmap)
 from .utils import serde
+from .utils.checkpoint import CheckpointManager
+from .utils.metrics import MetricsLogger
 
 
 def _ends_in_prob_activation(model: Model) -> bool:
@@ -65,7 +67,8 @@ class Trainer:
                  loss="categorical_crossentropy", features_col: str = "features",
                  label_col: str = "label", num_epoch: int = 1,
                  batch_size: int = 32, learning_rate: float = 0.01,
-                 seed: int = 0):
+                 seed: int = 0, checkpoint_dir: Optional[str] = None,
+                 checkpoint_keep: int = 3, metrics=None):
         self.model = keras_model
         self.worker_optimizer = worker_optimizer
         self.loss = loss
@@ -75,6 +78,12 @@ class Trainer:
         self.batch_size = int(batch_size)
         self.learning_rate = float(learning_rate)
         self.seed = int(seed)
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_keep = int(checkpoint_keep)
+        if metrics is None or isinstance(metrics, MetricsLogger):
+            self.metrics = metrics or MetricsLogger(None)
+        else:
+            self.metrics = MetricsLogger(metrics)
 
         self.history: list = []
         self.training_time: float = 0.0
@@ -113,8 +122,16 @@ class Trainer:
         self.model.variables = self.trained_variables
         return self.model
 
-    def train(self, dataset: Dataset, shuffle: bool = False) -> Model:
+    def train(self, dataset: Dataset, shuffle: bool = False,
+              resume: bool = False) -> Model:
+        """Parity: reference ``Trainer.train(dataframe, shuffle)``.
+
+        ``resume=True`` restarts from the latest checkpoint in
+        ``checkpoint_dir`` (our addition — the reference has no mid-training
+        persistence, SURVEY.md §5.4).
+        """
         t0 = time.time()
+        self._resume = bool(resume)
         try:
             return self._train(dataset, shuffle)
         finally:
@@ -122,6 +139,28 @@ class Trainer:
 
     def _train(self, dataset: Dataset, shuffle: bool) -> Model:
         raise NotImplementedError
+
+    # -- checkpoint plumbing -------------------------------------------------
+    def _ckpt_manager(self) -> Optional[CheckpointManager]:
+        if not self.checkpoint_dir:
+            return None
+        return CheckpointManager(self.checkpoint_dir, keep=self.checkpoint_keep)
+
+    def _maybe_restore(self, ckpt, state):
+        """Returns ``(state, start_epoch)``; restores iff resume requested."""
+        if ckpt is None or not getattr(self, "_resume", False):
+            return state, 0
+        if ckpt.latest_step() is None:
+            return state, 0
+        state, meta = ckpt.restore(state)
+        return state, int(meta.get("epoch", -1)) + 1
+
+    def _epoch_metrics(self, epoch: int, losses: np.ndarray, dt: float,
+                       samples: int) -> None:
+        self.metrics.log("epoch", trainer=type(self).__name__, epoch=epoch,
+                         mean_loss=float(np.mean(losses)),
+                         epoch_seconds=dt,
+                         samples_per_sec=samples / dt if dt > 0 else 0.0)
 
 
 class SingleTrainer(Trainer):
@@ -145,10 +184,21 @@ class SingleTrainer(Trainer):
         variables = self.model.init(self.seed)
         opt_state = optimizer.init(variables["params"])
         rng = jax.random.PRNGKey(self.seed + 1)
-        for _ in range(self.num_epoch):
+
+        ckpt = self._ckpt_manager()
+        (variables, opt_state, rng), start_epoch = self._maybe_restore(
+            ckpt, (variables, opt_state, rng))
+        samples = int(xs.shape[0]) * self.batch_size
+        for epoch in range(start_epoch, self.num_epoch):
+            te = time.time()
             variables, opt_state, rng, losses = run(variables, opt_state, rng,
                                                     xs, ys)
-            self.history.append(np.asarray(losses))
+            losses = np.asarray(losses)
+            self.history.append(losses)
+            self._epoch_metrics(epoch, losses, time.time() - te, samples)
+            if ckpt is not None:
+                ckpt.save(epoch, (variables, opt_state, rng),
+                          {"epoch": epoch})
         return self._finish(variables)
 
 
@@ -167,9 +217,10 @@ class DistributedTrainer(Trainer):
                  num_epoch: int = 1, batch_size: int = 32,
                  communication_window: Optional[int] = None,
                  learning_rate: float = 0.01, seed: int = 0,
-                 mode: str = "sync", mesh=None):
+                 mode: str = "sync", mesh=None, **kw):
         super().__init__(keras_model, worker_optimizer, loss, features_col,
-                         label_col, num_epoch, batch_size, learning_rate, seed)
+                         label_col, num_epoch, batch_size, learning_rate, seed,
+                         **kw)
         self.num_workers = int(num_workers)
         self.communication_window = int(
             communication_window if communication_window is not None
@@ -248,11 +299,25 @@ class DistributedTrainer(Trainer):
         rngs = jax.random.split(jax.random.PRNGKey(self.seed + 1), P)
         rngs = mesh_lib.host_to_mesh(mesh, rngs)
 
-        for _ in range(self.num_epoch):
+        ckpt = self._ckpt_manager()
+        (center, local, opt_state, rngs), start_epoch = self._maybe_restore(
+            ckpt, (center, local, opt_state, rngs))
+        if start_epoch:  # restored host arrays need re-placing on the mesh
+            center = mesh_lib.broadcast_to_mesh(mesh, center)
+            local = mesh_lib.host_to_mesh(mesh, local)
+            opt_state = mesh_lib.host_to_mesh(mesh, opt_state)
+            rngs = mesh_lib.host_to_mesh(mesh, rngs)
+        samples = int(xs.shape[1]) * int(xs.shape[2]) * self.batch_size * P
+        for epoch in range(start_epoch, self.num_epoch):
+            te = time.time()
             center, local, opt_state, rngs, losses = run(
                 center, local, opt_state, rngs, xs, ys)
-            self.history.append(
-                np.asarray(losses).reshape(P, -1))  # (workers, steps)
+            losses = np.asarray(losses).reshape(P, -1)
+            self.history.append(losses)  # (workers, steps)
+            self._epoch_metrics(epoch, losses, time.time() - te, samples)
+            if ckpt is not None:
+                ckpt.save(epoch, (center, local, opt_state, rngs),
+                          {"epoch": epoch})
         return self._collect(center, local)
 
     def _collect(self, center, local) -> Model:

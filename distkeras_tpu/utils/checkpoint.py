@@ -1,0 +1,101 @@
+"""Checkpoint / resume — the subsystem the reference lacks (SURVEY.md §5.4).
+
+The reference's only persistence is the final in-memory model; its
+serialize/deserialize pair is the de-facto format.  We provide real
+mid-training checkpointing: pytree leaves in our msgpack ndarray encoding
+(``utils.serde``), written atomically (tmp + rename), with a rolling-keep
+manager.  Restore unflattens into the structure of a caller-supplied
+reference tree (``like``) so arbitrary optax opt-states — NamedTuple
+chains msgpack can't represent — round-trip losslessly.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from . import serde
+
+Tree = Any
+
+
+def save_tree(path: str, tree: Tree, meta: Optional[dict] = None) -> None:
+    """Atomically write ``tree``'s leaves (+ JSON-able ``meta``)."""
+    leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+    blob = serde.tree_to_bytes({"leaves": leaves, "meta": meta or {}})
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_tree(path: str, like: Tree) -> tuple:
+    """Returns ``(tree, meta)`` with ``tree`` shaped like ``like``."""
+    with open(path, "rb") as f:
+        payload = serde.tree_from_bytes(f.read())
+    treedef = jax.tree_util.tree_structure(like)
+    ref_leaves = jax.tree_util.tree_leaves(like)
+    leaves = payload["leaves"]
+    if len(leaves) != len(ref_leaves):
+        raise ValueError(
+            f"checkpoint has {len(leaves)} leaves, reference tree has "
+            f"{len(ref_leaves)} — structure mismatch")
+    return jax.tree_util.tree_unflatten(treedef, leaves), payload["meta"]
+
+
+class CheckpointManager:
+    """Rolling checkpoints ``step-N.ckpt`` under a directory, keep last K."""
+
+    _PAT = re.compile(r"^step-(\d+)\.ckpt$")
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = directory
+        self.keep = int(keep)
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"step-{step}.ckpt")
+
+    def steps(self) -> list:
+        out = []
+        for name in os.listdir(self.directory):
+            m = self._PAT.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        s = self.steps()
+        return s[-1] if s else None
+
+    def save(self, step: int, tree: Tree, meta: Optional[dict] = None) -> str:
+        meta = dict(meta or {})
+        meta["step"] = int(step)
+        path = self._path(step)
+        save_tree(path, tree, meta)
+        for old in self.steps()[: -self.keep]:
+            try:
+                os.unlink(self._path(old))
+            except OSError:
+                pass
+        return path
+
+    def restore(self, like: Tree, step: Optional[int] = None) -> tuple:
+        """Returns ``(tree, meta)`` from ``step`` (default: latest)."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        return load_tree(self._path(step), like)

@@ -1,0 +1,90 @@
+"""Metrics / logging / profiling — SURVEY.md §5.1 + §5.5.
+
+The reference's observability is wall-clock + per-worker loss history plus
+Spark's web UI.  Ours: a structured JSONL metrics sink (stdout or file),
+trainer-emitted per-epoch records (loss, samples/sec, epoch seconds), and
+a ``jax.profiler`` trace context for TensorBoard/Perfetto captures.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import sys
+import time
+from typing import IO, Optional, Union
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics sink.
+
+    ``MetricsLogger("train.jsonl")`` or ``MetricsLogger(sys.stdout)``;
+    ``log(event, **fields)`` writes one line with a wall-clock timestamp.
+    """
+
+    def __init__(self, sink: Union[str, IO, None] = None):
+        self._own = False
+        if sink is None:
+            self._fh = None
+        elif isinstance(sink, str):
+            self._fh = open(sink, "a", buffering=1)
+            self._own = True
+        else:
+            self._fh = sink
+
+    def log(self, event: str, **fields) -> dict:
+        rec = {"ts": time.time(), "event": event, **fields}
+        if self._fh is not None:
+            self._fh.write(json.dumps(rec, default=float) + "\n")
+        return rec
+
+    def close(self) -> None:
+        if self._own and self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """Capture a ``jax.profiler`` trace (open with TensorBoard/Perfetto).
+
+    TPU equivalent of the reference leaning on the Spark UI for task
+    timing: wrap any training region::
+
+        with profile_trace("/tmp/trace"):
+            trainer.train(ds)
+    """
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Honest step timing: ``mark()`` between steps; ``rate(samples)``
+    reports samples/sec.  Callers are responsible for a hard sync (e.g. a
+    scalar readback) before ``mark`` — see bench.py's methodology note."""
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+        self.laps: list = []
+
+    def mark(self) -> float:
+        t = time.perf_counter()
+        lap = t - self.t0
+        self.t0 = t
+        self.laps.append(lap)
+        return lap
+
+    def rate(self, samples_per_lap: int) -> float:
+        if not self.laps:
+            return 0.0
+        return samples_per_lap * len(self.laps) / sum(self.laps)

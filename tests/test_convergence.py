@@ -1,0 +1,75 @@
+"""Convergence workflow — the reference's ``examples/workflow.ipynb`` as a
+test (SURVEY.md §4 item 3): every trainer on MNIST, each must reach a
+threshold accuracy; the distributed ones are compared against the
+SingleTrainer anchor.  Run explicitly: ``pytest -m convergence``.
+"""
+
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.data.transformers import OneHotTransformer
+
+pytestmark = pytest.mark.convergence
+
+N_TRAIN = 8192
+
+
+@pytest.fixture(scope="module")
+def mnist():
+    train, test, meta = dk.datasets.load_mnist(n_train=N_TRAIN)
+    enc = OneHotTransformer(10, "label", "label_onehot")
+    return enc.transform(train), enc.transform(test.take(2048))
+
+
+COMMON = dict(loss="categorical_crossentropy", features_col="features",
+              label_col="label_onehot", num_epoch=3, batch_size=64,
+              learning_rate=0.05)
+
+
+def accuracy(model, ds):
+    pred = dk.ModelPredictor(model, "features").predict(ds)
+    return dk.AccuracyEvaluator("prediction", "label").evaluate(pred)
+
+
+@pytest.fixture(scope="module")
+def anchor_acc(mnist):
+    train, test = mnist
+    t = dk.SingleTrainer(dk.zoo.mlp_mnist(hidden=128), "sgd", **COMMON)
+    m = t.train(train)
+    acc = accuracy(m, test)
+    assert acc > 0.9, f"SingleTrainer anchor failed to converge: {acc}"
+    return acc
+
+
+# DOWNPOUR/DynSGD sum worker deltas (reference PS semantics: every commit
+# applied in full), so the stable step scales as ~1/(workers×window): they
+# need a small window and lr, exactly as the upstream README warns (its
+# stated reason to prefer ADAG).
+@pytest.mark.parametrize("cls,kw", [
+    (dk.ADAG, dict(communication_window=8)),
+    (dk.DOWNPOUR, dict(communication_window=2, learning_rate=0.01)),
+    (dk.DynSGD, dict(communication_window=2, learning_rate=0.01)),
+    (dk.AEASGD, dict(communication_window=8, rho=1.0)),
+    (dk.EAMSGD, dict(communication_window=8, rho=1.0, momentum=0.9)),
+])
+def test_sync_trainers_near_anchor(mnist, anchor_acc, cls, kw):
+    train, test = mnist
+    t = cls(dk.zoo.mlp_mnist(hidden=128), "sgd", num_workers=8,
+            **{**COMMON, **kw})
+    acc = accuracy(t.train(train), test)
+    # distributed async algorithms trade a little accuracy for parallelism;
+    # within 15 points of the anchor and clearly learned
+    assert acc > max(0.65, anchor_acc - 0.15), (acc, anchor_acc)
+
+
+@pytest.mark.parametrize("cls,kw", [
+    (dk.DOWNPOUR, dict(communication_window=8)),
+    (dk.DynSGD, dict(communication_window=8)),
+])
+def test_async_trainers_converge(mnist, anchor_acc, cls, kw):
+    train, test = mnist
+    t = cls(dk.zoo.mlp_mnist(hidden=128), "sgd", num_workers=4,
+            mode="async", **COMMON, **kw)
+    acc = accuracy(t.train(train), test)
+    assert acc > max(0.6, anchor_acc - 0.2), (acc, anchor_acc)

@@ -1,0 +1,50 @@
+"""Job deployment (SURVEY.md §2 L6): package → subprocess execute → fetch."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.job_deployment import Job, Punchcard
+from distkeras_tpu.models.layers import Dense, Sequential
+from tests.test_trainers_sync import toy_problem
+
+
+def test_punchcard_parses(tmp_path):
+    p = tmp_path / "punchcard.json"
+    p.write_text(json.dumps({"host": "tpu-vm", "username": "ml",
+                             "key_file": "/k", "remote_dir": "/jobs"}))
+    pc = Punchcard(str(p))
+    assert pc.target == "ml@tpu-vm"
+    assert pc.remote_dir == "/jobs"
+
+
+def test_job_local_roundtrip(tmp_path):
+    """Full job cycle through a real subprocess (the reference's remote
+    spark-submit path, pointed at this machine)."""
+    ds = toy_problem(n=512)
+    npz = str(tmp_path / "data.npz")
+    np.savez(npz, features=ds["features"], label=ds["label"],
+             label_onehot=ds["label_onehot"])
+
+    model = dk.Model(Sequential([Dense(16, "relu"), Dense(3, "softmax")]),
+                     input_shape=(10,))
+    job = Job(
+        "toy-job", model,
+        trainer_spec={"class": "SingleTrainer",
+                      "kwargs": {"worker_optimizer": "sgd",
+                                 "loss": "categorical_crossentropy",
+                                 "features_col": "features",
+                                 "label_col": "label_onehot",
+                                 "num_epoch": 5, "batch_size": 32,
+                                 "learning_rate": 0.05}},
+        dataset_spec={"npz": npz},
+    )
+    trained = job.run(timeout=600)
+    assert trained.variables is not None
+    pred = dk.ModelPredictor(trained, "features").predict(ds)
+    acc = dk.AccuracyEvaluator("prediction", "label").evaluate(pred)
+    assert acc > 0.6
+    assert job.result_history is not None and len(job.result_history) == 5
