@@ -22,6 +22,7 @@ from .trainers import (
     EAMSGD,
     EnsembleTrainer,
     SingleTrainer,
+    SpmdTrainer,
     Trainer,
 )
 from .predictors import ModelPredictor, Predictor

@@ -155,6 +155,30 @@ def lstm_imdb(vocab_size: int = 20000, embed_dim: int = 128,
     ]), input_shape=(seq_len,), name="lstm_imdb")
 
 
+def transformer_classifier(vocab_size: int = 20000, dim: int = 128,
+                           num_heads: int = 4, num_blocks: int = 2,
+                           seq_len: int = 200, num_classes: int = 2,
+                           ff_mult: int = 4) -> Model:
+    """Pre-LN transformer encoder classifier — the long-context model
+    family the reference never had (its sequence ceiling was one worker's
+    LSTM, SURVEY.md §5.7).  Attention lowers to
+    ``ops.attention.MultiHeadAttention``; for sequences sharded over an
+    ``sp`` mesh axis the same math runs as ring attention
+    (``parallel.ring``)."""
+    from ..ops.attention import (GlobalAvgPool1D, LayerNorm,
+                                 MultiHeadAttention)
+    layers = [Embedding(vocab_size, dim)]
+    for _ in range(num_blocks):
+        layers.append(Residual(Sequential([
+            LayerNorm(), MultiHeadAttention(num_heads)])))
+        layers.append(Residual(Sequential([
+            LayerNorm(), Dense(dim * ff_mult, "gelu"), Dense(dim)])))
+    layers += [LayerNorm(), GlobalAvgPool1D(),
+               Dense(num_classes, "softmax")]
+    return Model(Sequential(layers), input_shape=(seq_len,),
+                 name="transformer_classifier")
+
+
 ZOO = {
     "mlp_mnist": mlp_mnist,
     "convnet_mnist": convnet_mnist,
@@ -162,4 +186,5 @@ ZOO = {
     "resnet20": resnet20,
     "resnet50": resnet50,
     "lstm_imdb": lstm_imdb,
+    "transformer_classifier": transformer_classifier,
 }

@@ -1,0 +1,109 @@
+"""Attention ops + MultiHeadAttention layer.
+
+The reference predates attention entirely (SURVEY.md §5.7: its sequence
+models are small LSTMs).  Long-context support is first-class here, so the
+framework ships a standard MXU-friendly attention stack:
+
+* ``dot_product_attention`` — fused-softmax reference implementation (XLA
+  fuses QK^T → softmax → PV into MXU-resident loops).
+* ``MultiHeadAttention`` — a ``Layer`` usable in Sequential stacks.
+* The sequence-parallel ring formulation lives in
+  ``distkeras_tpu.parallel.ring`` and reuses the same online-softmax math.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.layers import Layer, glorot_uniform, register
+
+
+def dot_product_attention(q, k, v, *, causal: bool = False,
+                          q_offset: int = 0, k_offset: int = 0):
+    """Scaled dot-product attention.
+
+    q: (B, Tq, H, Dh); k/v: (B, Tk, H, Dh) → (B, Tq, H, Dh).
+    ``q_offset``/``k_offset`` are global position offsets for causal
+    masking of sequence-sharded blocks (ring attention).
+    """
+    dh = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(dh)
+    if causal:
+        qi = jnp.arange(q.shape[1])[:, None] + q_offset
+        ki = jnp.arange(k.shape[1])[None, :] + k_offset
+        scores = jnp.where(ki <= qi, scores, jnp.finfo(scores.dtype).min)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+@register
+class MultiHeadAttention(Layer):
+    """Self-attention over (T, D) inputs; fused qkv projection (one
+    MXU-shaped (D, 3D) GEMM) + output projection."""
+
+    def __init__(self, num_heads: int, causal: bool = False):
+        self.num_heads = int(num_heads)
+        self.causal = bool(causal)
+
+    def init(self, rng, in_shape):
+        t, d = in_shape
+        if d % self.num_heads:
+            raise ValueError(f"model dim {d} not divisible by "
+                             f"{self.num_heads} heads")
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "qkv": glorot_uniform(k1, (d, 3 * d)),
+            "out": glorot_uniform(k2, (d, d)),
+        }
+        return params, {}, in_shape
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        b, t, d = x.shape
+        h = self.num_heads
+        dh = d // h
+        qkv = x @ params["qkv"].astype(x.dtype)          # (B, T, 3D)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, h, dh)
+        k = k.reshape(b, t, h, dh)
+        v = v.reshape(b, t, h, dh)
+        o = dot_product_attention(q, k, v, causal=self.causal)
+        o = o.reshape(b, t, d)
+        return o @ params["out"].astype(x.dtype), state
+
+    def get_config(self):
+        return {"num_heads": self.num_heads, "causal": self.causal}
+
+
+@register
+class LayerNorm(Layer):
+    def __init__(self, epsilon: float = 1e-5):
+        self.epsilon = float(epsilon)
+
+    def init(self, rng, in_shape):
+        d = in_shape[-1]
+        return {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))}, {}, in_shape
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + jnp.asarray(self.epsilon, x.dtype))
+        return y * params["scale"].astype(x.dtype) \
+            + params["bias"].astype(x.dtype), state
+
+    def get_config(self):
+        return {"epsilon": self.epsilon}
+
+
+@register
+class GlobalAvgPool1D(Layer):
+    """Mean over the time axis: (T, D) -> (D,)."""
+
+    def out_shape(self, in_shape):
+        return (in_shape[-1],)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return jnp.mean(x, axis=1), state

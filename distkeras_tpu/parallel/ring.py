@@ -1,0 +1,88 @@
+"""Ring attention — sequence/context parallelism over the ``sp`` mesh axis.
+
+Absent from the reference (SURVEY.md §5.7) but first-class here: sequences
+too long for one chip are sharded over the mesh's sequence axis; each
+device keeps its Q shard resident and the K/V shards rotate around the
+ring via ``lax.ppermute`` (one neighbor hop per step — bandwidth rides
+ICI, never a host).  Softmax is computed *online* (running max/denominator
+in f32, the flash-attention recurrence), so the full attention matrix is
+never materialized: memory is O(T_local²) per step instead of O(T²).
+
+Ref: Liu, Zaharia, Abbeel — "Ring Attention with Blockwise Transformers
+for Near-Infinite Context" (2023); math identical to our single-device
+``ops.attention.dot_product_attention`` (tested equal).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import shard_map
+from .sync import _shard_map_kw
+
+_NEG = -1e30  # finite -inf stand-in: keeps the online-softmax exp() NaN-free
+
+
+def ring_attention(q, k, v, axis_name: str, *, causal: bool = False):
+    """Blockwise ring attention; call INSIDE ``shard_map``.
+
+    q/k/v: per-device sequence shards (B, T_loc, H, Dh), sharded on T over
+    ``axis_name``.  Returns the attention output shard (B, T_loc, H, Dh).
+    """
+    p_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, t_loc, h, dh = q.shape
+    scale = 1.0 / math.sqrt(dh)
+
+    # f32 accumulators (numerics survive bf16 inputs)
+    o = jnp.zeros((b, t_loc, h, dh), jnp.float32)
+    l = jnp.zeros((b, h, t_loc), jnp.float32)
+    m = jnp.full((b, h, t_loc), _NEG, jnp.float32)
+
+    perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+    q_pos = my_idx * t_loc + jnp.arange(t_loc)
+
+    def step(i, carry):
+        o, l, m, kb, vb = carry
+        # kv block i originated on device (my_idx - i) mod p
+        src = (my_idx - i) % p_size
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kb,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = src * t_loc + jnp.arange(t_loc)
+            mask = k_pos[None, :] <= q_pos[:, None]        # (Tq, Tk)
+            s = jnp.where(mask[None, None], s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, vb.astype(jnp.float32))
+        o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return o_new, l_new, m_new, kb, vb
+
+    o, l, m, _, _ = lax.fori_loop(0, p_size, step, (o, l, m, k, v))
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(mesh: Mesh, q, k, v, *, axis: str = "sp",
+                           causal: bool = False):
+    """Whole-array entry point: shards q/k/v on the sequence (T) axis over
+    ``mesh[axis]`` and runs ring attention.  q/k/v: (B, T, H, Dh)."""
+    fn = shard_map(
+        partial(ring_attention, axis_name=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis),
+        **_shard_map_kw())
+    return fn(q, k, v)

@@ -1,0 +1,70 @@
+"""GSPMD sharding: multi-axis (dp × mp) training via sharding annotations.
+
+The scaling-book recipe, applied: pick a mesh, annotate parameter and batch
+shardings, let XLA insert the collectives.  Nothing here exchanges weights
+explicitly — data parallelism falls out of the batch being sharded on
+``dp`` (XLA all-reduces the grads), tensor parallelism out of large kernels
+being sharded on ``mp`` (XLA partitions the matmuls and inserts
+all-gather/reduce-scatter where profitable, riding ICI).
+
+This is the forward-looking path beyond the reference's pure data
+parallelism (its only strategy, SURVEY.md §2) — model families too large
+to replicate per chip (e.g. ResNet-50 heads, transformer stacks) shard
+here with no model-code changes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Tree = Any
+
+
+def infer_param_specs(params: Tree, mesh: Mesh, tp_axis: str = "mp",
+                      min_size: int = 2048) -> Tree:
+    """Heuristic tensor-parallel sharding rules.
+
+    For each parameter: shard its largest dimension over ``tp_axis`` when
+    (a) the dim is divisible by the axis size and (b) the tensor is big
+    enough to be worth the collectives; otherwise replicate.  Biases and
+    norm scales stay replicated.  XLA's SPMD partitioner propagates the
+    rest (activations, grads, opt state).
+    """
+    if tp_axis not in mesh.axis_names:
+        return jax.tree_util.tree_map(lambda _: P(), params)
+    tp = mesh.shape[tp_axis]
+
+    def spec(leaf):
+        shape = np.shape(leaf)
+        if len(shape) < 2 or np.prod(shape) < min_size:
+            return P()
+        dim = int(np.argmax(shape))
+        if shape[dim] % tp != 0:
+            return P()
+        parts = [None] * len(shape)
+        parts[dim] = tp_axis
+        return P(*parts)
+
+    return jax.tree_util.tree_map(spec, params)
+
+
+def place(tree: Tree, mesh: Mesh, specs: Tree):
+    """device_put a pytree according to a PartitionSpec tree."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs)
+
+
+def replicate(tree: Tree, mesh: Mesh):
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, P())), tree)
+
+
+def batch_sharding(mesh: Mesh, dp_axis: str = "dp", batch_dim: int = 0):
+    parts = [None] * (batch_dim + 1)
+    parts[batch_dim] = dp_axis
+    return NamedSharding(mesh, P(*parts))

@@ -407,6 +407,79 @@ class EnsembleTrainer(DistributedTrainer):
         return models
 
 
+class SpmdTrainer(Trainer):
+    """Multi-axis GSPMD trainer — the TPU-native strategy beyond the
+    reference's data parallelism: one jit-compiled train step over a
+    dp × mp mesh; XLA inserts the gradient all-reduce (dp) and partitions
+    large matmuls (mp) from sharding annotations alone
+    (``parallel.spmd``).  No reference equivalent; this is where models
+    too large to replicate train.
+
+    ``mesh_shape``: e.g. ``{"dp": 2, "mp": 4}`` (defaults to all devices
+    on dp).
+    """
+
+    def __init__(self, keras_model: Model, worker_optimizer="sgd",
+                 loss="categorical_crossentropy",
+                 mesh_shape: Optional[dict] = None, **kw):
+        super().__init__(keras_model, worker_optimizer, loss, **kw)
+        self.mesh_shape = mesh_shape
+
+    def _train(self, dataset: Dataset, shuffle: bool) -> Model:
+        from .parallel import spmd
+        if shuffle:
+            dataset = dataset.shuffle(self.seed)
+        loss_fn, optimizer = self._resolve()
+
+        if self.mesh_shape:
+            axes, sizes = zip(*self.mesh_shape.items())
+        else:
+            axes, sizes = ("dp",), (len(jax.devices()),)
+        mesh = mesh_lib.make_mesh(axis_names=axes, shape=sizes)
+        dp = "dp" if "dp" in axes else axes[0]
+
+        run = make_window_fn(self.model, loss_fn, optimizer)
+
+        ds = dataset.coalesce(1)
+        stacked, steps = ds.stacked([self.features_col, self.label_col],
+                                    self.batch_size)
+        bsh = spmd.batch_sharding(mesh, dp, batch_dim=1)  # (steps, batch,...)
+        xs = jax.device_put(stacked[self.features_col][0], bsh)
+        ys = jax.device_put(stacked[self.label_col][0], bsh)
+
+        variables = self.model.init(self.seed)
+        specs = spmd.infer_param_specs(variables["params"], mesh)
+        variables = {"params": spmd.place(variables["params"], mesh, specs),
+                     "state": spmd.replicate(variables["state"], mesh)}
+        opt_state = optimizer.init(variables["params"])
+        rng = jax.device_put(jax.random.PRNGKey(self.seed + 1),
+                             jax.sharding.NamedSharding(
+                                 mesh, jax.sharding.PartitionSpec()))
+
+        ckpt = self._ckpt_manager()
+        # shardings of the freshly-initialized state, to re-apply on resume
+        opt_shardings = jax.tree_util.tree_map(lambda x: x.sharding, opt_state)
+        (variables, opt_state, rng), start_epoch = self._maybe_restore(
+            ckpt, (variables, opt_state, rng))
+        if start_epoch:  # restored host arrays: re-apply GSPMD placement
+            variables = {
+                "params": spmd.place(variables["params"], mesh, specs),
+                "state": spmd.replicate(variables["state"], mesh)}
+            opt_state = jax.tree_util.tree_map(
+                jax.device_put, opt_state, opt_shardings)
+        samples = int(xs.shape[0]) * self.batch_size
+        for epoch in range(start_epoch, self.num_epoch):
+            te = time.time()
+            variables, opt_state, rng, losses = run(variables, opt_state,
+                                                    rng, xs, ys)
+            losses = np.asarray(losses)
+            self.history.append(losses)
+            self._epoch_metrics(epoch, losses, time.time() - te, samples)
+            if ckpt is not None:
+                ckpt.save(epoch, (variables, opt_state, rng), {"epoch": epoch})
+        return self._finish(variables)
+
+
 class AsynchronousDistributedTrainer(DistributedTrainer):
     """Base for the asynchronous algorithm family (reference
     ``AsynchronousDistributedTrainer``).  In sync mode these run their

@@ -1,0 +1,81 @@
+"""Attention + ring attention: correctness against the dense reference."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distkeras_tpu.ops.attention import (GlobalAvgPool1D, LayerNorm,
+                                         MultiHeadAttention,
+                                         dot_product_attention)
+from distkeras_tpu.parallel.mesh import make_mesh
+from distkeras_tpu.parallel.ring import ring_attention_sharded
+
+
+def qkv(b=2, t=32, h=4, dh=16, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (b, t, h, dh)
+    return (rng.normal(size=shape).astype(np.float32),
+            rng.normal(size=shape).astype(np.float32),
+            rng.normal(size=shape).astype(np.float32))
+
+
+def test_dense_attention_is_softmax_weighted():
+    q, k, v = qkv(t=8)
+    out = dot_product_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    assert out.shape == q.shape
+    # row weights sum to 1 -> output within convex hull of values
+    assert float(jnp.max(jnp.abs(out))) <= float(np.abs(v).max()) + 1e-5
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_dense(causal):
+    q, k, v = qkv(t=32)
+    dense = dot_product_attention(jnp.asarray(q), jnp.asarray(k),
+                                  jnp.asarray(v), causal=causal)
+    mesh = make_mesh(8, ("sp",))
+    ring = ring_attention_sharded(mesh, jnp.asarray(q), jnp.asarray(k),
+                                  jnp.asarray(v), causal=causal)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_under_jit_and_grad():
+    """Ring attention must be differentiable and jittable (it sits inside
+    training steps)."""
+    q, k, v = map(jnp.asarray, qkv(t=16))
+    mesh = make_mesh(8, ("sp",))
+
+    @jax.jit
+    def loss(q, k, v):
+        return jnp.sum(ring_attention_sharded(mesh, q, k, v) ** 2)
+
+    g = jax.grad(loss)(q, k, v)
+    assert np.isfinite(np.asarray(g)).all()
+    # grad matches the dense formulation's grad
+    def dense_loss(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v) ** 2)
+    gd = jax.grad(dense_loss)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gd),
+                               rtol=5e-4, atol=5e-5)
+
+
+def test_mha_layer_in_model():
+    import distkeras_tpu as dk
+    from distkeras_tpu.models.layers import Dense, Embedding, Sequential
+    model = dk.Model(Sequential([
+        Embedding(100, 32),
+        MultiHeadAttention(4),
+        LayerNorm(),
+        GlobalAvgPool1D(),
+        Dense(2, "softmax"),
+    ]), input_shape=(16,))
+    v = model.init(0)
+    x = np.zeros((3, 16), np.int32)
+    y, _ = model.apply(v, x)
+    assert y.shape == (3, 2)
+    np.testing.assert_allclose(np.asarray(y).sum(-1), 1.0, rtol=1e-5)
+    # serde roundtrip
+    m2 = dk.Model.from_config(model.config())
+    y2, _ = m2.apply(v, x)
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(y), rtol=1e-6)
