@@ -22,32 +22,51 @@ import jax.numpy as jnp
 from ..models.layers import Layer, glorot_uniform, register
 
 
-def dot_product_attention(q, k, v, *, causal: bool = False,
-                          q_offset: int = 0, k_offset: int = 0):
+def dot_product_attention(q, k, v, *, causal: bool = False):
     """Scaled dot-product attention.
 
     q: (B, Tq, H, Dh); k/v: (B, Tk, H, Dh) → (B, Tq, H, Dh).
-    ``q_offset``/``k_offset`` are global position offsets for causal
-    masking of sequence-sharded blocks (ring attention).
     """
     dh = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(dh)
     if causal:
-        qi = jnp.arange(q.shape[1])[:, None] + q_offset
-        ki = jnp.arange(k.shape[1])[None, :] + k_offset
+        qi = jnp.arange(q.shape[1])[:, None]
+        ki = jnp.arange(k.shape[1])[None, :]
         scores = jnp.where(ki <= qi, scores, jnp.finfo(scores.dtype).min)
     weights = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 
+def _largest_divisor_block(t: int, cap: int = 128) -> int:
+    """Largest block size ≤ cap dividing t (flash kernels need whole
+    blocks; T=200 → 100, T=256 → 128, prime T → 1)."""
+    for b in range(min(cap, t), 0, -1):
+        if t % b == 0:
+            return b
+    return 1
+
+
 @register
 class MultiHeadAttention(Layer):
     """Self-attention over (T, D) inputs; fused qkv projection (one
-    MXU-shaped (D, 3D) GEMM) + output projection."""
+    MXU-shaped (D, 3D) GEMM) + output projection.
 
-    def __init__(self, num_heads: int, causal: bool = False):
+    ``impl``: ``"dense"`` (XLA-fused reference) or ``"flash"`` (the Pallas
+    VMEM-resident kernel, ``ops.pallas_attention``).  Flash gives O(T·D)
+    HBM traffic on the FORWARD only — its backward currently recomputes
+    through the dense formulation (O(T²) memory), so for long-context
+    TRAINING the sequence-parallel path (``parallel.ring``) is the one
+    that scales; flash shines for long-context inference and short-to-mid
+    training sequences.
+    """
+
+    def __init__(self, num_heads: int, causal: bool = False,
+                 impl: str = "dense"):
+        if impl not in ("dense", "flash"):
+            raise ValueError(f"impl must be 'dense' or 'flash', got {impl!r}")
         self.num_heads = int(num_heads)
         self.causal = bool(causal)
+        self.impl = impl
 
     def init(self, rng, in_shape):
         t, d = in_shape
@@ -70,12 +89,18 @@ class MultiHeadAttention(Layer):
         q = q.reshape(b, t, h, dh)
         k = k.reshape(b, t, h, dh)
         v = v.reshape(b, t, h, dh)
-        o = dot_product_attention(q, k, v, causal=self.causal)
+        if self.impl == "flash":
+            from .pallas_attention import flash_attention
+            blk = _largest_divisor_block(t)
+            o = flash_attention(q, k, v, self.causal, blk, blk)
+        else:
+            o = dot_product_attention(q, k, v, causal=self.causal)
         o = o.reshape(b, t, d)
         return o @ params["out"].astype(x.dtype), state
 
     def get_config(self):
-        return {"num_heads": self.num_heads, "causal": self.causal}
+        return {"num_heads": self.num_heads, "causal": self.causal,
+                "impl": self.impl}
 
 
 @register

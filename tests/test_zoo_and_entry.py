@@ -14,11 +14,13 @@ from distkeras_tpu.models.model import Model
     ("convnet_cifar10", (2, 32, 32, 3), (2, 10)),
     ("resnet20", (2, 32, 32, 3), (2, 10)),
     ("lstm_imdb", (2, 200), (2, 1)),
+    ("transformer_classifier", (2, 200), (2, 2)),
 ])
 def test_zoo_forward_shapes(name, xshape, oshape):
     model = zoo.ZOO[name]()
     v = model.init(0)
-    x = np.zeros(xshape, np.int32 if name == "lstm_imdb" else np.float32)
+    int_input = name in ("lstm_imdb", "transformer_classifier")
+    x = np.zeros(xshape, np.int32 if int_input else np.float32)
     y, _ = model.apply(v, x)
     assert y.shape == oshape
     # config serde roundtrip preserves output
