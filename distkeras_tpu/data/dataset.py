@@ -43,6 +43,29 @@ class Dataset:
     def from_arrays(cls, **columns) -> "Dataset":
         return cls(columns)
 
+    @classmethod
+    def from_csv(cls, path: str, num_features: int,
+                 label_col: str = "label", features_col: str = "features",
+                 label_first: bool = True, nthreads: int = 0) -> "Dataset":
+        """Load a numeric CSV of ``num_features + 1`` columns per row (the
+        reference's MNIST-CSV shape: label + flat pixels) via the native
+        multithreaded parser (``native/dknative.cpp``), NumPy fallback.
+        """
+        from ..utils import native
+        flat = native.parse_csv(path, nthreads)
+        width = num_features + 1
+        if flat.size % width:
+            raise ValueError(
+                f"CSV value count {flat.size} not divisible by row width "
+                f"{width}")
+        rows = flat.reshape(-1, width)
+        if label_first:
+            labels, feats = rows[:, 0], rows[:, 1:]
+        else:
+            labels, feats = rows[:, -1], rows[:, :-1]
+        return cls({features_col: np.ascontiguousarray(feats),
+                    label_col: labels.astype(np.int64)})
+
     # -- Spark-surface ops --------------------------------------------------
     def repartition(self, n: int) -> "Dataset":
         """Parity: ``df.repartition(num_workers)``."""

@@ -1,0 +1,112 @@
+"""Keras-3 model ingestion — train *actual Keras models* on this framework.
+
+The reference's entire API is Keras-model-in, Keras-model-out (its trainers
+pickle ``keras.Model`` objects to Spark executors).  Keras 3 ships a JAX
+backend with a stateless functional API, which lets us compile unmodified
+Keras models straight into our jit-compiled train steps:
+
+    kmodel = keras.Sequential([...])        # any Keras 3 model
+    model = KerasAdapter(kmodel)
+    SingleTrainer(model, "sgd", "categorical_crossentropy").train(ds)
+
+``KerasAdapter`` implements the same protocol as ``models.Model`` (init /
+apply / layer.apply / config), so every trainer, predictor and serde path
+accepts it unchanged.  Under the hood ``apply`` is
+``keras.Model.stateless_call`` — pure, jit-safe, differentiable.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+_KERAS = None
+
+
+def _keras():
+    """Import keras lazily with the JAX backend enforced."""
+    global _KERAS
+    if _KERAS is not None:
+        return _KERAS
+    os.environ.setdefault("KERAS_BACKEND", "jax")
+    import keras
+    if keras.backend.backend() != "jax":
+        raise RuntimeError(
+            f"keras backend is {keras.backend.backend()!r}; distkeras_tpu "
+            f"needs the JAX backend (set KERAS_BACKEND=jax before importing "
+            f"keras)")
+    _KERAS = keras
+    return keras
+
+
+class _KerasLayerShim:
+    """Adapts ``stateless_call`` to the ``Layer.apply`` signature trainers
+    compile against."""
+
+    def __init__(self, adapter: "KerasAdapter"):
+        self._adapter = adapter
+
+    def apply(self, params, state, x, *, train: bool = False, rng=None):
+        outputs, new_state = self._adapter.keras_model.stateless_call(
+            params, state, x, training=train)
+        return outputs, new_state
+
+
+class KerasAdapter:
+    """Wrap a built Keras 3 model into the ``Model`` protocol."""
+
+    def __init__(self, keras_model, input_shape: Optional[Sequence[int]] = None):
+        keras = _keras()
+        if not keras_model.built:
+            if input_shape is None:
+                raise ValueError("pass input_shape= for an unbuilt model")
+            keras_model.build((None, *input_shape))
+        self.keras_model = keras_model
+        shape = keras_model.input_shape
+        self.input_shape = tuple(int(s) for s in shape[1:])
+        self.output_shape = tuple(
+            int(s) for s in keras_model.output_shape[1:])
+        self.name = keras_model.name
+        self.variables: Optional[dict] = None
+
+        self.layer = _KerasLayerShim(self)
+
+    # -- Model protocol -----------------------------------------------------
+    def init(self, rng=0) -> dict:
+        """Snapshot the model's (freshly built) variables as a pytree.
+
+        Keras owns initialization; ``rng`` keeps signature parity (pass a
+        different int and re-build for decorrelated ensembles)."""
+        return {
+            "params": [np.asarray(v) for v in
+                       self.keras_model.trainable_variables],
+            "state": [np.asarray(v) for v in
+                      self.keras_model.non_trainable_variables],
+        }
+
+    def apply(self, variables: dict, x, *, train: bool = False, rng=None):
+        return self.layer.apply(variables["params"], variables["state"], x,
+                                train=train, rng=rng)
+
+    def predict_fn(self):
+        def fn(variables, x):
+            y, _ = self.apply(variables, x, train=False)
+            return y
+        return fn
+
+    # -- serde ---------------------------------------------------------------
+    def config(self) -> dict:
+        return {"keras_json": self.keras_model.to_json(),
+                "input_shape": list(self.input_shape)}
+
+    @classmethod
+    def from_config(cls, cfg: dict) -> "KerasAdapter":
+        keras = _keras()
+        kmodel = keras.models.model_from_json(cfg["keras_json"])
+        return cls(kmodel, input_shape=cfg.get("input_shape"))
+
+    def __repr__(self):
+        return (f"KerasAdapter({self.name!r}, in={self.input_shape}, "
+                f"out={self.output_shape})")

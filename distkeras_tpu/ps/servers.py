@@ -22,9 +22,20 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from ..parallel.sync import tmap as _tree_map
+from ..utils import native
 from .networking import recv_msg, send_msg
 
 Tree = Any
+
+
+def _tree_fused_add(center: Tree, delta: Tree, scale: float) -> Tree:
+    """center + scale·delta leaf-wise via the native data plane
+    (``native/dknative.cpp``) — one fused multithreaded pass per leaf, GIL
+    released; NumPy fallback.  Returns NEW arrays (replace semantics keep
+    the lock-free pull/checkpoint snapshots race-free)."""
+    return _tree_map(lambda c, d: native.fused_add(np.asarray(c),
+                                                   np.asarray(d), scale),
+                     center, delta)
 
 
 class ParameterServer:
@@ -86,7 +97,7 @@ class DeltaParameterServer(ParameterServer):
     Parity: reference ``DeltaParameterServer``."""
 
     def apply_commit(self, delta, meta):
-        self.center = _tree_map(lambda c, d: c + d, self.center, delta)
+        self.center = _tree_fused_add(self.center, delta, 1.0)
 
 
 class ADAGParameterServer(ParameterServer):
@@ -95,8 +106,8 @@ class ADAGParameterServer(ParameterServer):
     upstream README's recommended algorithm)."""
 
     def apply_commit(self, delta, meta):
-        s = 1.0 / self.num_workers
-        self.center = _tree_map(lambda c, d: c + d * s, self.center, delta)
+        self.center = _tree_fused_add(self.center, delta,
+                                      1.0 / self.num_workers)
 
 
 class DynSGDParameterServer(ParameterServer):
@@ -106,8 +117,8 @@ class DynSGDParameterServer(ParameterServer):
 
     def apply_commit(self, delta, meta):
         staleness = max(0, self.num_updates - int(meta.get("last_update", 0)))
-        s = 1.0 / (staleness + 1)
-        self.center = _tree_map(lambda c, d: c + d * s, self.center, delta)
+        self.center = _tree_fused_add(self.center, delta,
+                                      1.0 / (staleness + 1))
 
 
 class SocketParameterServer:

@@ -44,11 +44,21 @@ from .utils.checkpoint import CheckpointManager
 from .utils.metrics import MetricsLogger
 
 
-def _ends_in_prob_activation(model: Model) -> bool:
+def _ends_in_prob_activation(model) -> bool:
     """Reference models end in a softmax (or sigmoid, for binary heads)
     layer and train with crossentropy on probabilities (Keras semantics).
     Detect that so the loss can use the numerically-stable on-probs
-    variant."""
+    variant.  Works for native models and ingested Keras-3 models."""
+    kmodel = getattr(model, "keras_model", None)
+    if kmodel is not None:
+        try:
+            last = kmodel.layers[-1]
+            if type(last).__name__ in ("Softmax", "Sigmoid"):
+                return True
+            act = getattr(last, "activation", None)
+            return getattr(act, "__name__", None) in ("softmax", "sigmoid")
+        except (IndexError, AttributeError):
+            return False
     layer = model.layer
     while isinstance(layer, Sequential) and layer.layers:
         layer = layer.layers[-1]

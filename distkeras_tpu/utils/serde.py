@@ -75,8 +75,17 @@ def serialize_model(model, variables: Any = None) -> bytes:
 
 
 def deserialize_model(data: bytes):
-    """Returns ``(model, variables)``; variables is None if not saved."""
+    """Returns ``(model, variables)``; variables is None if not saved.
+
+    Handles both native configs (``models.Model``) and ingested Keras-3
+    models (``models.keras_adapter.KerasAdapter``).
+    """
     from ..models.model import Model
     payload = tree_from_bytes(data)
-    model = Model.from_config(json.loads(payload["arch"]))
+    cfg = json.loads(payload["arch"])
+    if "keras_json" in cfg:
+        from ..models.keras_adapter import KerasAdapter
+        model = KerasAdapter.from_config(cfg)
+    else:
+        model = Model.from_config(cfg)
     return model, payload.get("variables")

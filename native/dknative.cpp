@@ -1,0 +1,172 @@
+// Host-side native data plane for distkeras_tpu.
+//
+// The reference delegated all native work to external substrates (Spark's
+// JVM for ingest/shuffle, TF's C++ for kernels — SURVEY.md §2 "Native
+// components").  Our runtime keeps the TPU compute path in XLA/Pallas and
+// implements the host hot paths here:
+//
+//   * dk_fused_add / dk_axpy_inplace — the parameter-server commit rule
+//     (center' = center + scale·delta) as a single fused multithreaded
+//     pass.  NumPy needs two passes (tmp = delta*scale; center + tmp) and
+//     holds the GIL in between; this releases the GIL (called via ctypes)
+//     and saturates memory bandwidth with N threads.
+//   * dk_parse_csv_f32 — multithreaded CSV→float32 ingest (the reference's
+//     examples read MNIST as CSV through Spark; this is the single-host
+//     equivalent).
+//
+// Exposed with C linkage for ctypes (no pybind11 in this image).
+
+#include <cctype>
+#include <cstddef>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+inline unsigned clamp_threads(int nthreads, size_t n, size_t grain) {
+  unsigned hw = std::thread::hardware_concurrency();
+  if (hw == 0) hw = 1;
+  unsigned t = nthreads > 0 ? static_cast<unsigned>(nthreads) : hw;
+  size_t max_by_grain = n / grain + 1;
+  if (t > max_by_grain) t = static_cast<unsigned>(max_by_grain);
+  return t == 0 ? 1 : t;
+}
+
+template <typename F>
+void parallel_chunks(size_t n, int nthreads, size_t grain, F&& fn) {
+  unsigned t = clamp_threads(nthreads, n, grain);
+  if (t <= 1) {
+    fn(0, n);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(t);
+  size_t chunk = (n + t - 1) / t;
+  for (unsigned i = 0; i < t; ++i) {
+    size_t lo = i * chunk;
+    size_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    threads.emplace_back([lo, hi, &fn] { fn(lo, hi); });
+  }
+  for (auto& th : threads) th.join();
+}
+
+constexpr size_t kGrain = 1 << 16;  // don't spawn threads for tiny arrays
+
+}  // namespace
+
+extern "C" {
+
+// dst = a + scale * b   (single fused pass)
+void dk_fused_add_f32(float* dst, const float* a, const float* b,
+                      float scale, size_t n, int nthreads) {
+  parallel_chunks(n, nthreads, kGrain, [=](size_t lo, size_t hi) {
+    for (size_t i = lo; i < hi; ++i) dst[i] = a[i] + scale * b[i];
+  });
+}
+
+// dst += scale * src
+void dk_axpy_inplace_f32(float* dst, const float* src, float scale, size_t n,
+                         int nthreads) {
+  parallel_chunks(n, nthreads, kGrain, [=](size_t lo, size_t hi) {
+    for (size_t i = lo; i < hi; ++i) dst[i] += scale * src[i];
+  });
+}
+
+void dk_fused_add_f64(double* dst, const double* a, const double* b,
+                      double scale, size_t n, int nthreads) {
+  parallel_chunks(n, nthreads, kGrain, [=](size_t lo, size_t hi) {
+    for (size_t i = lo; i < hi; ++i) dst[i] = a[i] + scale * b[i];
+  });
+}
+
+// Parse ASCII decimal floats separated by commas/whitespace/newlines.
+// Returns the number of values written (<= max_vals).  Thread-parallel:
+// the buffer is split at line boundaries and each shard parses
+// independently into its own span, sized by a counting prepass.
+size_t dk_parse_csv_f32(const char* buf, size_t len, float* out,
+                        size_t max_vals, int nthreads) {
+  if (len == 0 || max_vals == 0) return 0;
+  unsigned t = clamp_threads(nthreads, len, 1 << 20);
+
+  // shard boundaries snapped to '\n'
+  std::vector<size_t> starts(t + 1, 0);
+  starts[t] = len;
+  for (unsigned i = 1; i < t; ++i) {
+    size_t pos = len * i / t;
+    while (pos < len && buf[pos] != '\n') ++pos;
+    starts[i] = pos < len ? pos + 1 : len;
+  }
+
+  auto is_sep = [](char c) {
+    return c == ',' || c == '\n' || c == '\r' || c == ' ' || c == '\t';
+  };
+  auto numeric_start = [](char c) {
+    return (c >= '0' && c <= '9') || c == '-' || c == '+' || c == '.';
+  };
+
+  // Count/parse share one token rule — a token is counted (and later
+  // written) iff its first character looks numeric; header words and other
+  // junk are skipped by BOTH passes, keeping per-thread spans in lockstep.
+  auto count_values = [&](size_t lo, size_t hi) {
+    size_t cnt = 0;
+    bool in_tok = false;
+    for (size_t i = lo; i < hi; ++i) {
+      char c = buf[i];
+      if (!is_sep(c) && !in_tok) {
+        if (numeric_start(c)) ++cnt;
+        in_tok = true;
+      } else if (is_sep(c)) {
+        in_tok = false;
+      }
+    }
+    return cnt;
+  };
+
+  std::vector<size_t> counts(t, 0);
+  {
+    std::vector<std::thread> threads;
+    for (unsigned i = 0; i < t; ++i)
+      threads.emplace_back([&, i] { counts[i] = count_values(starts[i],
+                                                             starts[i + 1]); });
+    for (auto& th : threads) th.join();
+  }
+  std::vector<size_t> offsets(t + 1, 0);
+  for (unsigned i = 0; i < t; ++i) offsets[i + 1] = offsets[i] + counts[i];
+  size_t total = offsets[t] < max_vals ? offsets[t] : max_vals;
+
+  auto parse_span = [&](size_t lo, size_t hi, size_t off) {
+    size_t w = off;
+    size_t i = lo;
+    while (i < hi && w < total) {
+      char c = buf[i];
+      if (is_sep(c)) {
+        ++i;
+        continue;
+      }
+      if (numeric_start(c)) {
+        char* end = nullptr;
+        out[w++] = strtof(buf + i, &end);
+        if (end != nullptr && static_cast<size_t>(end - buf) > i)
+          i = static_cast<size_t>(end - buf);
+      }
+      while (i < hi && !is_sep(buf[i])) ++i;  // skip to end of token
+    }
+  };
+
+  {
+    std::vector<std::thread> threads;
+    for (unsigned i = 0; i < t; ++i)
+      threads.emplace_back([&, i] { parse_span(starts[i], starts[i + 1],
+                                               offsets[i]); });
+    for (auto& th : threads) th.join();
+  }
+  return total;
+}
+
+int dk_version() { return 1; }
+
+}  // extern "C"

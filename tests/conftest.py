@@ -12,6 +12,8 @@ XLA_FLAGS must still be in the environment before the CPU client spins up.
 
 import os
 
+os.environ.setdefault("KERAS_BACKEND", "jax")  # Keras-3 ingestion adapter
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,59 @@
+"""Keras-3 ingestion: unmodified Keras models through our trainers."""
+
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from tests.test_trainers_sync import COMMON, toy_problem
+
+keras = pytest.importorskip("keras")
+if keras.backend.backend() != "jax":
+    pytest.skip("keras is not on the JAX backend in this environment",
+                allow_module_level=True)
+
+from distkeras_tpu.models.keras_adapter import KerasAdapter  # noqa: E402
+
+
+def build_keras_mlp():
+    m = keras.Sequential([
+        keras.layers.Input((10,)),
+        keras.layers.Dense(32, activation="relu"),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    return KerasAdapter(m)
+
+
+@pytest.fixture(scope="module")
+def ds():
+    return toy_problem()
+
+
+def test_keras_model_trains_single(ds):
+    model = build_keras_mlp()
+    t = dk.SingleTrainer(model, "sgd", **COMMON)
+    m = t.train(ds)
+    pred = dk.ModelPredictor(m, "features").predict(ds)
+    acc = dk.AccuracyEvaluator("prediction", "label").evaluate(pred)
+    assert acc > 0.9, acc
+
+
+def test_keras_model_trains_distributed(ds):
+    model = build_keras_mlp()
+    t = dk.ADAG(model, "sgd", num_workers=8, communication_window=4, **COMMON)
+    m = t.train(ds)
+    pred = dk.ModelPredictor(m, "features").predict(ds)
+    acc = dk.AccuracyEvaluator("prediction", "label").evaluate(pred)
+    assert acc > 0.55, acc
+
+
+def test_keras_serde_roundtrip(ds):
+    from distkeras_tpu.utils import serde
+    model = build_keras_mlp()
+    variables = model.init(0)
+    blob = serde.serialize_model(model, variables)
+    m2, v2 = serde.deserialize_model(blob)
+    assert isinstance(m2, KerasAdapter)
+    x = ds["features"][:16]
+    y1, _ = model.apply(variables, x)
+    y2, _ = m2.apply(v2, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-6)
