@@ -27,9 +27,9 @@ from distkeras_tpu.ops.optimizers import get_optimizer  # noqa: E402
 from distkeras_tpu.parallel.sync import make_window_fn  # noqa: E402
 
 BATCH = int(os.environ.get("BENCH_BATCH", 1024))
-STEPS_PER_CALL = 8
+STEPS_PER_CALL = 32
 WARMUP_CALLS = 2
-TIMED_CALLS = int(os.environ.get("BENCH_CALLS", 6))
+TIMED_CALLS = int(os.environ.get("BENCH_CALLS", 4))
 ANCHOR_PATH = os.path.join(ROOT, "BENCH_ANCHOR.json")
 
 

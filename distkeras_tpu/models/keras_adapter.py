@@ -75,15 +75,21 @@ class KerasAdapter:
 
     # -- Model protocol -----------------------------------------------------
     def init(self, rng=0) -> dict:
-        """Snapshot the model's (freshly built) variables as a pytree.
+        """Variables pytree for seed ``rng``.
 
-        Keras owns initialization; ``rng`` keeps signature parity (pass a
-        different int and re-build for decorrelated ensembles)."""
+        ``rng=0`` snapshots the model as built (Keras owns that init); any
+        other int deterministically re-initializes a clone with
+        ``keras.utils.set_random_seed`` — this is what gives
+        EnsembleTrainer decorrelated members."""
+        model = self.keras_model
+        if rng not in (0, None):
+            keras = _keras()
+            keras.utils.set_random_seed(int(rng) & 0x7FFFFFFF)
+            model = keras.models.model_from_json(self.keras_model.to_json())
+            model.build((None, *self.input_shape))
         return {
-            "params": [np.asarray(v) for v in
-                       self.keras_model.trainable_variables],
-            "state": [np.asarray(v) for v in
-                      self.keras_model.non_trainable_variables],
+            "params": [np.asarray(v) for v in model.trainable_variables],
+            "state": [np.asarray(v) for v in model.non_trainable_variables],
         }
 
     def apply(self, variables: dict, x, *, train: bool = False, rng=None):

@@ -1,0 +1,100 @@
+"""Multi-host bring-up — ``jax.distributed`` in place of the Spark cluster.
+
+The reference scales out by asking Spark for more executors; we scale out
+by starting one identical process per TPU host (SURVEY.md §2 L0).  After
+``initialize()``, ``jax.devices()`` spans the whole pod/slice, every mesh
+built by the trainers is global, and the same SPMD programs run unchanged
+— collectives ride ICI within a slice and DCN across slices.
+
+Typical pod usage (same script on every host)::
+
+    from distkeras_tpu.parallel import multihost
+    multihost.initialize()                 # env-driven on TPU pods
+    ds = multihost.local_shard(dataset)    # this host's partitions
+    ADAG(model, ..., num_workers=jax.device_count()).train(ds)
+
+The async-PS mode composes too: run the ``SocketParameterServer`` on
+process 0 (it already listens on TCP/DCN) and point workers at
+``coordinator host:port``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Initialize the JAX multi-process runtime.
+
+    On Cloud TPU pods all three arguments are discovered from the
+    metadata/env automatically (pass nothing).  Explicit values mirror the
+    reference's ``Punchcard`` host lists for manual clusters.  No-op when
+    already initialized or single-process.
+
+    MUST run before anything initializes the XLA backend (even
+    ``jax.process_count()`` counts) — call it first thing in the program.
+    """
+    global _initialized
+    if _initialized:
+        return
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = int(num_processes)
+    if process_id is not None:
+        kwargs["process_id"] = int(process_id)
+    if not kwargs and "COORDINATOR_ADDRESS" in os.environ:
+        kwargs["coordinator_address"] = os.environ["COORDINATOR_ADDRESS"]
+        kwargs["num_processes"] = int(os.environ.get("NUM_PROCESSES", "1"))
+        kwargs["process_id"] = int(os.environ.get("PROCESS_ID", "0"))
+    try:
+        jax.distributed.initialize(**kwargs)
+        _initialized = True
+    except (RuntimeError, ValueError):
+        if kwargs:
+            raise  # explicit config that failed is an error
+        # single-process environment without coordinator: fine as-is
+        _initialized = True
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def local_shard(dataset):
+    """This host's contiguous slice of a Dataset (one partition group per
+    process) — the moral equivalent of Spark shipping each executor its
+    partitions.  With P processes the dataset is repartitioned to a
+    multiple of P and process k takes partitions [k·(n/P), (k+1)·(n/P)).
+    """
+    import numpy as np
+
+    from ..data.dataset import Dataset
+
+    p = jax.process_count()
+    if p == 1:
+        return dataset
+    k = jax.process_index()
+    n_parts = dataset.num_partitions
+    if n_parts % p:
+        n_parts = p * max(1, n_parts // p)
+        dataset = dataset.repartition(n_parts)
+    per = dataset.num_partitions // p
+    cols = {}
+    for name in dataset.column_names:
+        parts = [dataset.partition(i)[name]
+                 for i in range(k * per, (k + 1) * per)]
+        cols[name] = np.concatenate(parts)
+    return Dataset(cols, num_partitions=per)

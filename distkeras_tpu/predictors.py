@@ -71,3 +71,46 @@ class ModelPredictor(Predictor):
             outs.append(np.asarray(fn(variables, jnp.asarray(xb[i]))))
         preds = np.concatenate(outs)[:n]
         return dataset.with_column(self.output_col, preds)
+
+
+class StreamingPredictor(Predictor):
+    """Online prediction over an unbounded stream — parity with the
+    reference's Kafka + Spark-Streaming example (``examples/`` in the
+    reference: a trained model mapped over a DStream of feature rows).
+
+    Feed any iterator of feature arrays (single rows or batches); get
+    predictions back with bounded latency.  Rows are micro-batched to
+    ``batch_size`` and padded to a fixed shape so XLA compiles exactly one
+    program (no recompilation per batch — the streaming analogue of the
+    static-shape rule).
+
+    ``predict_stream`` yields one prediction per input row, in order.
+    """
+
+    def __init__(self, keras_model: Model, variables: Optional[dict] = None,
+                 batch_size: int = 64):
+        super().__init__(keras_model, variables)
+        self.batch_size = int(batch_size)
+        self._fn = jax.jit(self.model.predict_fn())
+
+    def _predict_batch(self, rows: list) -> np.ndarray:
+        x = np.stack(rows)
+        k = x.shape[0]
+        if k < self.batch_size:  # pad to the compiled shape
+            x = np.concatenate(
+                [x, np.repeat(x[-1:], self.batch_size - k, axis=0)])
+        return np.asarray(self._fn(self.variables, jnp.asarray(x)))[:k]
+
+    def predict_stream(self, feature_iter):
+        buf: list = []
+        for item in feature_iter:
+            item = np.asarray(item)
+            if item.ndim == len(self.model.input_shape):  # single row
+                buf.append(item)
+            else:  # already a batch
+                buf.extend(item)
+            while len(buf) >= self.batch_size:
+                batch, buf = buf[: self.batch_size], buf[self.batch_size:]
+                yield from self._predict_batch(batch)
+        if buf:
+            yield from self._predict_batch(buf)

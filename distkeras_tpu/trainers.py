@@ -410,7 +410,8 @@ class EnsembleTrainer(DistributedTrainer):
         local = jax.tree_util.tree_map(np.asarray, local)
         models = []
         for i in range(P):
-            m = Model.from_config(self.model.config())
+            # type(...) so ingested Keras models (KerasAdapter) work too
+            m = type(self.model).from_config(self.model.config())
             m.variables = tmap(lambda l: l[i], local)
             models.append(m)
         self.trained_variables = models[0].variables

@@ -106,9 +106,24 @@ def parse_csv(path: str, nthreads: int = 0) -> np.ndarray:
         buf = f.read()
     lib = _load()
     if lib is None:
-        txt = buf.decode()
-        return np.fromstring(txt.replace("\n", ","), sep=",",
-                             dtype=np.float32)  # pragma: no cover
+        # fallback with the SAME token semantics as the native parser:
+        # split on , \n \r space \t; keep numeric-start tokens only
+        import re
+        vals = []
+        for tok in re.split(rb"[,\r\n \t]+", buf):
+            if tok and (tok[0:1].isdigit() or tok[0:1] in (b"-", b"+", b".")):
+                try:
+                    vals.append(float(tok))
+                except ValueError:
+                    # strtof semantics: parse the leading numeric prefix
+                    m = re.match(rb"[-+.]?[0-9]*\.?[0-9]*(?:[eE][-+]?[0-9]+)?",
+                                 tok)
+                    if m and m.group():
+                        try:
+                            vals.append(float(m.group()))
+                        except ValueError:
+                            pass
+        return np.asarray(vals, dtype=np.float32)
     # upper bound on value count: one per separator byte + 1
     max_vals = sum(buf.count(s) for s in (b",", b"\n", b"\r", b" ", b"\t")) + 2
     out = np.empty(max_vals, np.float32)

@@ -1,0 +1,52 @@
+"""MNIST end-to-end — the reference's ``examples/mnist.ipynb`` as a script.
+
+Pipeline (MinMax → OneHot) → SingleTrainer anchor → ADAG distributed →
+prediction → LabelIndex → accuracy.  Runs on one TPU chip or on 8 virtual
+CPU devices (set ``XLA_FLAGS=--xla_force_host_platform_device_count=8
+JAX_PLATFORMS=cpu``).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+import distkeras_tpu as dk
+from distkeras_tpu.data.transformers import (LabelIndexTransformer,
+                                             OneHotTransformer)
+
+NUM_WORKERS = min(8, len(jax.devices()))
+
+
+def main():
+    train, test, meta = dk.datasets.load_mnist(n_train=16384)
+    print(f"MNIST: {len(train)} train rows (synthetic={meta['synthetic']})")
+
+    enc = OneHotTransformer(10, "label", "label_onehot")
+    train, test = enc.transform(train), enc.transform(test.take(4096))
+
+    common = dict(loss="categorical_crossentropy", features_col="features",
+                  label_col="label_onehot", num_epoch=5, batch_size=64,
+                  learning_rate=0.05)
+
+    def evaluate(model):
+        pred = dk.ModelPredictor(model, "features").predict(test)
+        pred = LabelIndexTransformer(10, "prediction", "pred_idx").transform(pred)
+        return dk.AccuracyEvaluator("pred_idx", "label").evaluate(pred)
+
+    anchor = dk.SingleTrainer(dk.zoo.mlp_mnist(), "sgd", **common)
+    model = anchor.train(train, shuffle=True)
+    print(f"SingleTrainer: acc={evaluate(model):.4f} "
+          f"time={anchor.get_training_time():.1f}s")
+
+    adag = dk.ADAG(dk.zoo.mlp_mnist(), "sgd", num_workers=NUM_WORKERS,
+                   communication_window=8, **common)
+    model = adag.train(train, shuffle=True)
+    print(f"ADAG({NUM_WORKERS} workers): acc={evaluate(model):.4f} "
+          f"time={adag.get_training_time():.1f}s")
+
+
+if __name__ == "__main__":
+    main()

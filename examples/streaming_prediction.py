@@ -1,0 +1,49 @@
+"""Online prediction over a stream — the reference's Kafka/Spark-Streaming
+example, minus Kafka: any Python iterator is the stream (plug a Kafka
+consumer in by yielding its messages' feature vectors).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time
+
+import numpy as np
+
+import distkeras_tpu as dk
+from distkeras_tpu.data.transformers import OneHotTransformer
+from distkeras_tpu.predictors import StreamingPredictor
+
+
+def main():
+    train, _test, meta = dk.datasets.load_mnist(n_train=8192)
+    train = OneHotTransformer(10, "label", "label_onehot").transform(train)
+
+    trainer = dk.SingleTrainer(dk.zoo.mlp_mnist(), "sgd",
+                               "categorical_crossentropy",
+                               label_col="label_onehot", num_epoch=3,
+                               batch_size=64, learning_rate=0.05)
+    model = trainer.train(train, shuffle=True)
+    print(f"trained in {trainer.get_training_time():.1f}s; streaming...")
+
+    def event_stream(n=1000):
+        """Stand-in for a Kafka consumer: one feature row at a time."""
+        rng = np.random.default_rng(7)
+        for _ in range(n):
+            idx = rng.integers(0, len(train))
+            yield train["features"][idx]
+
+    predictor = StreamingPredictor(model, batch_size=128)
+    t0 = time.time()
+    n = 0
+    for pred in predictor.predict_stream(event_stream()):
+        n += 1
+    dt = time.time() - t0
+    print(f"streamed {n} predictions in {dt:.2f}s "
+          f"({n / dt:.0f} rows/sec, micro-batched at 128)")
+
+
+if __name__ == "__main__":
+    main()

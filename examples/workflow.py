@@ -1,0 +1,75 @@
+"""Trainer comparison workflow — the reference's ``examples/workflow.ipynb``.
+
+Every trainer on the same MNIST task; prints the accuracy/time table the
+reference plotted.  The async variants run against a real localhost
+parameter server.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+import distkeras_tpu as dk
+from distkeras_tpu.data.transformers import OneHotTransformer
+
+NUM_WORKERS = min(8, len(jax.devices()))
+
+
+def main():
+    train, test, meta = dk.datasets.load_mnist(n_train=16384)
+    enc = OneHotTransformer(10, "label", "label_onehot")
+    train, test = enc.transform(train), enc.transform(test.take(4096))
+
+    common = dict(loss="categorical_crossentropy", features_col="features",
+                  label_col="label_onehot", num_epoch=3, batch_size=64,
+                  learning_rate=0.05)
+
+    def accuracy(model):
+        pred = dk.ModelPredictor(model, "features").predict(test)
+        return dk.AccuracyEvaluator("prediction", "label").evaluate(pred)
+
+    configs = [
+        ("SingleTrainer", dk.SingleTrainer, {}, {}),
+        ("ADAG (sync)", dk.ADAG,
+         dict(num_workers=NUM_WORKERS, communication_window=8), {}),
+        ("DOWNPOUR (sync)", dk.DOWNPOUR,
+         dict(num_workers=NUM_WORKERS, communication_window=2),
+         dict(learning_rate=0.01)),
+        ("DynSGD (sync)", dk.DynSGD,
+         dict(num_workers=NUM_WORKERS, communication_window=2),
+         dict(learning_rate=0.01)),
+        ("AEASGD (sync)", dk.AEASGD,
+         dict(num_workers=NUM_WORKERS, communication_window=8, rho=1.0), {}),
+        ("EAMSGD (sync)", dk.EAMSGD,
+         dict(num_workers=NUM_WORKERS, communication_window=8, rho=1.0,
+              momentum=0.9), {}),
+        ("AveragingTrainer", dk.AveragingTrainer,
+         dict(num_workers=NUM_WORKERS), {}),
+        ("DOWNPOUR (async)", dk.DOWNPOUR,
+         dict(num_workers=4, communication_window=4, mode="async"),
+         dict(learning_rate=0.01)),
+        ("DynSGD (async)", dk.DynSGD,
+         dict(num_workers=4, communication_window=4, mode="async"),
+         dict(learning_rate=0.01)),
+    ]
+
+    print(f"{'trainer':22s} {'accuracy':>9s} {'time(s)':>8s}")
+    for name, cls, kw, overrides in configs:
+        t = cls(dk.zoo.mlp_mnist(), "sgd", **{**common, **overrides}, **kw)
+        model = t.train(train, shuffle=True)
+        print(f"{name:22s} {accuracy(model):9.4f} "
+              f"{t.get_training_time():8.1f}")
+
+    t = dk.EnsembleTrainer(dk.zoo.mlp_mnist(), "sgd",
+                           num_ensembles=NUM_WORKERS, **common)
+    models = t.train(train, shuffle=True)
+    accs = [accuracy(m) for m in models[:3]]
+    print(f"{'EnsembleTrainer':22s} {max(accs):9.4f} "
+          f"{t.get_training_time():8.1f}  (best of first 3 members)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""StreamingPredictor + multihost helpers."""
+
+import numpy as np
+
+import distkeras_tpu as dk
+from distkeras_tpu.parallel import multihost
+from distkeras_tpu.predictors import StreamingPredictor
+from tests.test_trainers_sync import COMMON, make_model, toy_problem
+
+
+def test_streaming_predictor_matches_batch():
+    ds = toy_problem(n=512)
+    t = dk.SingleTrainer(make_model(), "sgd", **COMMON)
+    model = t.train(ds)
+
+    batch_pred = dk.ModelPredictor(model, "features").predict(ds)
+    expected = batch_pred["prediction"]
+
+    sp = StreamingPredictor(model, batch_size=64)
+
+    def stream():  # mixed single rows and batches, odd total
+        yield ds["features"][0]
+        yield ds["features"][1:100]
+        for i in range(100, 151):
+            yield ds["features"][i]
+
+    out = np.stack(list(sp.predict_stream(stream())))
+    assert out.shape == (151, 3)
+    np.testing.assert_allclose(out, expected[:151], rtol=1e-5, atol=1e-6)
+
+
+def test_multihost_single_process_noop():
+    multihost.initialize()  # must be a no-op without a coordinator
+    assert multihost.process_count() == 1
+    assert multihost.process_index() == 0
+    ds = toy_problem(n=128)
+    assert multihost.local_shard(ds) is ds
