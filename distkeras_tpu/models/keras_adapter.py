@@ -75,18 +75,28 @@ class KerasAdapter:
 
     # -- Model protocol -----------------------------------------------------
     def init(self, rng=0) -> dict:
-        """Variables pytree for seed ``rng``.
+        """Snapshot the model's variables as a pytree.
 
-        ``rng=0`` snapshots the model as built (Keras owns that init); any
-        other int deterministically re-initializes a clone with
-        ``keras.utils.set_random_seed`` — this is what gives
-        EnsembleTrainer decorrelated members."""
-        model = self.keras_model
-        if rng not in (0, None):
-            keras = _keras()
-            keras.utils.set_random_seed(int(rng) & 0x7FFFFFFF)
-            model = keras.models.model_from_json(self.keras_model.to_json())
-            model.build((None, *self.input_shape))
+        ``rng`` is accepted for signature parity but IGNORED: the wrapped
+        model's weights (possibly pretrained) are the init — trainers pass
+        their seed here and must never silently discard a pretrained
+        snapshot.  For deliberately decorrelated fresh inits (ensembles)
+        use :meth:`reinit`."""
+        return {
+            "params": [np.asarray(v) for v in
+                       self.keras_model.trainable_variables],
+            "state": [np.asarray(v) for v in
+                      self.keras_model.non_trainable_variables],
+        }
+
+    def reinit(self, rng: int) -> dict:
+        """Deterministic FRESH initialization keyed on ``rng`` (a seeded
+        clone re-init; used by EnsembleTrainer for decorrelated members).
+        Note: seeds Keras' global RNG as a side effect of cloning."""
+        keras = _keras()
+        keras.utils.set_random_seed(int(rng) & 0x7FFFFFFF)
+        model = keras.models.model_from_json(self.keras_model.to_json())
+        model.build((None, *self.input_shape))
         return {
             "params": [np.asarray(v) for v in model.trainable_variables],
             "state": [np.asarray(v) for v in model.non_trainable_variables],

@@ -281,8 +281,14 @@ class Conv2D(Layer):
 
 
 class _Pool2D(Layer):
-    _init_val: float
-    _op = None
+    """Pooling via stacked strided slices instead of ``lax.reduce_window``.
+
+    One static slice per (i, j) window offset (p² slices, e.g. 9 for 3×3),
+    reduced with max/mean.  Equivalent math, but differentiable everywhere
+    reverse-mode runs — ``reduce_window`` fails to linearize inside
+    ``shard_map`` (jax 0.9), which the distributed conv trainers hit —
+    and XLA fuses the slices back into one windowed reduction.
+    """
 
     def __init__(self, pool_size=2, strides=None, padding="VALID"):
         self.pool_size = (pool_size, pool_size) if isinstance(pool_size, int) else tuple(pool_size)
@@ -298,10 +304,26 @@ class _Pool2D(Layer):
             return (-(-h // sh), -(-w // sw), c)
         return ((h - ph) // sh + 1, (w - pw) // sw + 1, c)
 
-    def _reduce(self, x):
-        return lax.reduce_window(
-            x, jnp.array(self._init_val, x.dtype), self._op,
-            (1, *self.pool_size, 1), (1, *self.strides, 1), self.padding)
+    def _pads(self, h, w):
+        if self.padding != "SAME":
+            return (0, 0), (0, 0)
+        ph, pw = self.pool_size
+        sh, sw = self.strides
+        oh, ow = -(-h // sh), -(-w // sw)
+        dh = max(0, (oh - 1) * sh + ph - h)
+        dw = max(0, (ow - 1) * sw + pw - w)
+        return (dh // 2, dh - dh // 2), (dw // 2, dw - dw // 2)
+
+    def _patches(self, x):
+        """(p²,) list of (B, OH, OW, C) strided slices of padded input."""
+        _, h, w, _ = x.shape
+        ph, pw = self.pool_size
+        sh, sw = self.strides
+        oh = (h - ph) // sh + 1
+        ow = (w - pw) // sw + 1
+        return [x[:, i: i + (oh - 1) * sh + 1: sh,
+                  j: j + (ow - 1) * sw + 1: sw, :]
+                for i in range(ph) for j in range(pw)]
 
     def get_config(self):
         return {"pool_size": list(self.pool_size), "strides": list(self.strides),
@@ -310,28 +332,33 @@ class _Pool2D(Layer):
 
 @register
 class MaxPool2D(_Pool2D):
-    _init_val = -jnp.inf
-    _op = staticmethod(lax.max)
-
     def apply(self, params, state, x, *, train=False, rng=None):
-        return self._reduce(x), state
+        (pt, pb), (pl, pr) = self._pads(x.shape[1], x.shape[2])
+        if pt or pb or pl or pr:
+            neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+            x = jnp.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0)),
+                        constant_values=neg)
+        patches = self._patches(x)
+        out = patches[0]
+        for p in patches[1:]:
+            out = jnp.maximum(out, p)
+        return out, state
 
 
 @register
 class AvgPool2D(_Pool2D):
-    _init_val = 0.0
-    _op = staticmethod(lax.add)
-
     def apply(self, params, state, x, *, train=False, rng=None):
-        total = self._reduce(x)
-        if self.padding == "SAME":
-            # average over valid (unpadded) elements only, like Keras
-            counts = lax.reduce_window(
-                jnp.ones(x.shape[1:3], x.dtype)[None, :, :, None],
-                jnp.array(0.0, x.dtype), lax.add,
-                (1, *self.pool_size, 1), (1, *self.strides, 1), self.padding)
+        (pt, pb), (pl, pr) = self._pads(x.shape[1], x.shape[2])
+        if pt or pb or pl or pr:
+            # average over valid (unpadded) elements only, like Keras:
+            # zero-pad the values, divide by the per-window valid count
+            mask = jnp.ones((1, x.shape[1], x.shape[2], 1), x.dtype)
+            x = jnp.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+            mask = jnp.pad(mask, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+            total = sum(self._patches(x))
+            counts = sum(self._patches(mask))
             return total / counts, state
-        return total / math.prod(self.pool_size), state
+        return sum(self._patches(x)) / math.prod(self.pool_size), state
 
 
 @register

@@ -58,11 +58,22 @@ def initialize(coordinator_address: Optional[str] = None,
     try:
         jax.distributed.initialize(**kwargs)
         _initialized = True
-    except (RuntimeError, ValueError):
+    except ValueError:
         if kwargs:
             raise  # explicit config that failed is an error
-        # single-process environment without coordinator: fine as-is
-        _initialized = True
+        # auto mode on a machine with no coordinator configured: fine as a
+        # single process.  _initialized stays False so a later explicit
+        # call can still form the cluster.
+    except RuntimeError as e:
+        # a configured pod that failed to come up is ALWAYS an error —
+        # swallowing it would let every host silently train the full
+        # dataset independently.  The one benign RuntimeError in auto mode
+        # is "backend already initialized / called too late" on a
+        # single-process run, where there is nothing to form.
+        msg = str(e).lower()
+        benign = not kwargs and ("before" in msg or "already" in msg)
+        if not benign:
+            raise
 
 
 def process_count() -> int:
@@ -87,14 +98,11 @@ def local_shard(dataset):
     if p == 1:
         return dataset
     k = jax.process_index()
-    n_parts = dataset.num_partitions
-    if n_parts % p:
-        n_parts = p * max(1, n_parts // p)
-        dataset = dataset.repartition(n_parts)
-    per = dataset.num_partitions // p
-    cols = {}
-    for name in dataset.column_names:
-        parts = [dataset.partition(i)[name]
-                 for i in range(k * per, (k + 1) * per)]
-        cols[name] = np.concatenate(parts)
-    return Dataset(cols, num_partitions=per)
+    # split row indices directly: robust to datasets smaller than the
+    # process count (some hosts then get an empty shard rather than a
+    # crash)
+    per_rows = np.array_split(np.arange(dataset.num_rows), p)[k]
+    cols = {name: dataset[name][per_rows]
+            for name in dataset.column_names}
+    per_parts = max(1, dataset.num_partitions // p)
+    return Dataset(cols, num_partitions=per_parts)

@@ -81,10 +81,31 @@ def run_async_training(trainer, dataset, fault_injector=None):
             w.start()
         for w in workers:
             w.join()
-        for w in workers:
-            if w.error is not None:
+        # failed-task retry, the reference's implicit Spark behavior
+        # (SURVEY.md §3.1: a failed executor task is rescheduled and its
+        # partition silently re-trained): re-run each failed worker ONCE
+        # from the current center; a second failure is fatal.
+        for i, w in enumerate(workers):
+            if w.error is None:
+                continue
+            fresh_center = ps.get_model()
+            kw = {"alpha": trainer.alpha} if worker_cls is ElasticWorker else {}
+            dev = w.device
+            retry = worker_cls(
+                w.worker_id, window_fn,
+                jax.device_put(fresh_center, dev),
+                jax.device_put(optimizer.init(fresh_center["params"]), dev),
+                jax.device_put(jax.random.PRNGKey(
+                    trainer.seed + 101 + w.worker_id), dev),
+                "127.0.0.1", server.port, num_epoch, device=dev, **kw)
+            retry.set_data(xs[w.worker_id], ys[w.worker_id])
+            retry.start()
+            retry.join()
+            if retry.error is not None:
                 raise RuntimeError(
-                    f"async worker {w.worker_id} failed") from w.error
+                    f"async worker {w.worker_id} failed twice"
+                ) from retry.error
+            workers[i] = retry
     finally:
         server.stop()
 

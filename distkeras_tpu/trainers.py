@@ -392,8 +392,11 @@ class EnsembleTrainer(DistributedTrainer):
         xs = mesh_lib.host_to_mesh(mesh, xs)
         ys = mesh_lib.host_to_mesh(mesh, ys)
 
-        # independent inits per ensemble member
-        inits = [self.model.init(self.seed + i) for i in range(P)]
+        # independent inits per ensemble member (reinit = deliberate fresh
+        # decorrelated init; Keras adapters keep init() as the pretrained
+        # snapshot and expose reinit separately)
+        fresh = getattr(self.model, "reinit", self.model.init)
+        inits = [fresh(self.seed + i) for i in range(P)]
         local = tmap(lambda *xs_: np.stack([np.asarray(x) for x in xs_]),
                      *inits)
         local = mesh_lib.host_to_mesh(mesh, local)

@@ -51,11 +51,14 @@ def test_keras_ensemble_decorrelated(ds):
     the adapter, and init(rng) must decorrelate members (review
     regressions)."""
     model = build_keras_mlp()
-    v0 = model.init(0)
-    v1 = model.init(1)
-    assert not np.allclose(v0["params"][0], v1["params"][0])
+    # init() snapshots the wrapped (possibly pretrained) weights no matter
+    # the seed; reinit() gives deliberate decorrelated fresh inits
+    np.testing.assert_array_equal(np.asarray(model.init(0)["params"][0]),
+                                  np.asarray(model.init(42)["params"][0]))
+    v1 = model.reinit(1)
+    assert not np.allclose(model.init(0)["params"][0], v1["params"][0])
     # deterministic per seed
-    np.testing.assert_array_equal(np.asarray(model.init(1)["params"][0]),
+    np.testing.assert_array_equal(np.asarray(model.reinit(1)["params"][0]),
                                   np.asarray(v1["params"][0]))
 
     t = dk.EnsembleTrainer(model, "sgd", num_ensembles=8,

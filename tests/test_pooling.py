@@ -1,0 +1,71 @@
+"""Pooling layers: parity with reduce_window + grads under shard_map.
+
+Regression for a jax 0.9 limitation: ``lax.reduce_window`` fails to
+linearize inside ``shard_map``, which broke every distributed conv
+trainer.  Pooling is now stacked strided slices (see ``_Pool2D``).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+
+import distkeras_tpu as dk
+from distkeras_tpu.data.transformers import OneHotTransformer
+from distkeras_tpu.models.layers import AvgPool2D, MaxPool2D
+
+
+@pytest.mark.parametrize("cls", [MaxPool2D, AvgPool2D])
+@pytest.mark.parametrize("pool,stride,pad", [
+    (2, None, "VALID"), (3, 2, "VALID"), (2, None, "SAME"), (3, 2, "SAME"),
+])
+def test_pool_matches_reduce_window(cls, pool, stride, pad):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 9, 9, 3)).astype(np.float32))
+    layer = cls(pool, stride, pad)
+    y, _ = layer.apply({}, {}, x)
+    op, init = ((lax.max, -jnp.inf) if cls is MaxPool2D else (lax.add, 0.0))
+    ref = lax.reduce_window(x, jnp.array(init, x.dtype), op,
+                            (1, *layer.pool_size, 1),
+                            (1, *layer.strides, 1), pad)
+    if cls is AvgPool2D:
+        cnt = lax.reduce_window(jnp.ones_like(x[:1, :, :, :1]),
+                                jnp.array(0.0, x.dtype), lax.add,
+                                (1, *layer.pool_size, 1),
+                                (1, *layer.strides, 1), pad)
+        ref = ref / cnt
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5,
+                               atol=1e-6)
+    assert y.shape == (2, *layer.out_shape((9, 9, 3)))
+
+
+def test_pool_grads_exist():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 8, 8, 3)).astype(np.float32))
+    for layer in (MaxPool2D(2), AvgPool2D(3, 2, "SAME")):
+        g = jax.grad(lambda x: jnp.sum(layer.apply({}, {}, x)[0] ** 2))(x)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+def test_distributed_conv_trainer_runs():
+    """The actual regression: grad through pooling inside the shard_map
+    epoch program."""
+    rng = np.random.default_rng(2)
+    n = 256
+    ds = dk.Dataset({"features": rng.random((n, 16, 16, 3), dtype=np.float32),
+                     "label": rng.integers(0, 4, size=n)})
+    ds = OneHotTransformer(4, "label", "label_onehot").transform(ds)
+    model = dk.Model(
+        dk.models.layers.Sequential([
+            dk.models.layers.Conv2D(8, 3, activation="relu"),
+            MaxPool2D(2),
+            dk.models.layers.Flatten(),
+            dk.models.layers.Dense(4, "softmax"),
+        ]), input_shape=(16, 16, 3))
+    t = dk.ADAG(model, "sgd", num_workers=8, communication_window=2,
+                loss="categorical_crossentropy", features_col="features",
+                label_col="label_onehot", num_epoch=1, batch_size=8,
+                learning_rate=0.05)
+    t.train(ds)
+    assert t.trained_variables is not None

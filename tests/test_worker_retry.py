@@ -1,0 +1,45 @@
+"""Async worker failure → single retry (the reference's Spark task-retry
+behavior, SURVEY.md §3.1/§5.3)."""
+
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu.ps import workers as workers_mod
+from tests.test_trainers_sync import COMMON, make_model, toy_problem
+
+
+def test_failed_worker_is_retried_once(monkeypatch):
+    ds = toy_problem(n=512)
+    calls = {"n": 0}
+    orig = workers_mod.PullCommitWorker._window
+
+    def flaky_window(self, client, wx, wy):
+        if self.worker_id == 1:
+            calls["n"] += 1
+            if calls["n"] == 1:  # first attempt of worker 1 dies mid-epoch
+                raise RuntimeError("injected worker crash")
+        return orig(self, client, wx, wy)
+
+    monkeypatch.setattr(workers_mod.PullCommitWorker, "_window", flaky_window)
+    t = dk.DOWNPOUR(make_model(), "sgd", num_workers=2, mode="async",
+                    communication_window=4, **COMMON)
+    m = t.train(ds)
+    assert m.variables is not None
+    assert calls["n"] >= 2  # the worker ran again after the injected crash
+    assert len(t.get_history()) == COMMON["num_epoch"]
+
+
+def test_twice_failed_worker_raises(monkeypatch):
+    ds = toy_problem(n=512)
+
+    def always_fail(self, client, wx, wy):
+        if self.worker_id == 0:
+            raise RuntimeError("persistent crash")
+        return workers_mod.StalenessWorker._window(self, client, wx, wy)
+
+    monkeypatch.setattr(workers_mod.PullCommitWorker, "_window", always_fail)
+    t = dk.DOWNPOUR(make_model(), "sgd", num_workers=2, mode="async",
+                    communication_window=4, **COMMON)
+    with pytest.raises(RuntimeError, match="failed twice"):
+        t.train(ds)
