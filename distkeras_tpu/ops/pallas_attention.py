@@ -1,23 +1,23 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels — fused forward AND backward.
 
 The one hot op where hand-scheduling beats XLA's fusion: dense attention
-materializes the (T×T) score matrix in HBM; this kernel streams K/V blocks
-through VMEM on a (batch·head, q-block, k-block) grid and keeps the
-online-softmax running max/denominator/accumulator in VMEM scratch that
-persists across the k dimension of the grid — HBM traffic is O(T·D)
-instead of O(T²) and VMEM stays bounded by the block sizes, so sequence
-length is limited by HBM, not by the score matrix (verified: T=16k+ on one
-v5e chip where the dense path's scores alone would need tens of GB).
+materializes the (T×T) score matrix in HBM; these kernels stream K/V
+blocks through VMEM on a (batch·head, block, block) grid with the
+online-softmax running statistics in VMEM scratch that persists across the
+minor grid dimension — HBM traffic is O(T·D) instead of O(T²), so
+sequence length is limited by HBM, not by the score matrix (verified:
+T=16k+ on one v5e chip where the dense path's scores alone would need
+tens of GB).
+
+Backward is the standard flash recurrence (Dao 2022): the forward saves
+only O and the per-row logsumexp L; dQ and dK/dV are each one fused kernel
+re-computing P = exp(S − L) blockwise, so training memory is O(T·D) too.
 
 Math follows the same blockwise recurrence as
 ``parallel.ring.ring_attention`` (intra-chip instead of inter-chip); both
-are tested equal to ``ops.attention.dot_product_attention``.  On non-TPU
-backends the kernel runs in Pallas interpret mode (slow but exact) so
-tests stay hermetic.
-
-Backward: ``jax.custom_vjp`` re-computing through the dense formulation —
-correct everywhere, O(T²) memory on the backward only.  A fused backward
-kernel is future work.
+are tested equal to ``ops.attention.dot_product_attention``, gradients
+included.  On non-TPU backends the kernels run in Pallas interpret mode
+(slow but exact) so tests stay hermetic.
 """
 
 from __future__ import annotations
@@ -37,13 +37,35 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _HAS_PLTPU = False
 
-from .attention import dot_product_attention
-
 _NEG = -1e30
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, o_acc, m_acc, l_acc, *,
-                 causal: bool, scale: float, block_q: int, block_k: int):
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu" or not _HAS_PLTPU
+
+
+def _dot(a, b):  # f32 MXU matmul without input truncation
+    return lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                           precision=lax.Precision.HIGHEST)
+
+
+def _dot_t(a, b):  # a @ b.T
+    return lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                           precision=lax.Precision.HIGHEST)
+
+
+def _causal_mask(qi, kb, block_q, block_k, shape):
+    q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, shape, 0)
+    k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, shape, 1)
+    return k_pos <= q_pos
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_acc, m_acc, l_acc, *,
+                causal: bool, scale: float, block_q: int, block_k: int):
     """Grid (bh, qi, kb): one K/V block per step; accumulators persist
     across kb (TPU executes the grid sequentially, minor-most last)."""
     qi = pl.program_id(1)
@@ -57,16 +79,10 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, o_acc, m_acc, l_acc, *,
         l_acc[:] = jnp.zeros_like(l_acc)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale       # (BQ, D)
-        k = k_ref[0].astype(jnp.float32)               # (BK, D)
-        v = v_ref[0].astype(jnp.float32)
-        # HIGHEST precision: keep f32 inputs un-truncated on the MXU
-        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            precision=lax.Precision.HIGHEST)
+        q = q_ref[0].astype(jnp.float32) * scale
+        s = _dot_t(q, k_ref[0].astype(jnp.float32))
         if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = k_pos <= q_pos
+            mask = _causal_mask(qi, kb, block_q, block_k, s.shape)
             s = jnp.where(mask, s, _NEG)
         m_prev = m_acc[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
@@ -75,9 +91,8 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, o_acc, m_acc, l_acc, *,
             p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(m_prev - m_new)
         l_acc[:, 0] = l_acc[:, 0] * corr + jnp.sum(p, axis=-1)
-        pv = lax.dot_general(p, v, (((1,), (0,)), ((), ())),
-                             precision=lax.Precision.HIGHEST)
-        o_acc[:] = o_acc[:] * corr[:, None] + pv
+        o_acc[:] = o_acc[:] * corr[:, None] + _dot(
+            p, v_ref[0].astype(jnp.float32))
         m_acc[:, 0] = m_new
 
     if causal:
@@ -88,46 +103,187 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, o_acc, m_acc, l_acc, *,
 
     @pl.when(kb == n_kb - 1)
     def _finalize():
-        o_ref[0] = (o_acc[:] / l_acc[:, 0][:, None]).astype(o_ref.dtype)
+        l = l_acc[:, 0]
+        o_ref[0] = (o_acc[:] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_acc[:, 0] + jnp.log(l)
 
 
-def _flash_fwd(q, k, v, *, causal: bool, block_q: int, block_k: int,
-               interpret: bool):
-    b, t, h, dh = q.shape
-    scale = 1.0 / math.sqrt(dh)
-    # (B*H, T, Dh) layout: grid walks (batch*head, q-block, k-block)
-    qr = q.transpose(0, 2, 1, 3).reshape(b * h, t, dh)
-    kr = k.transpose(0, 2, 1, 3).reshape(b * h, t, dh)
-    vr = v.transpose(0, 2, 1, 3).reshape(b * h, t, dh)
+def _flash_fwd_raw(qr, kr, vr, *, causal, bq, bk, scale):
+    """(BH, T, D) in → (out (BH,T,D), lse (BH,T)) via the fused kernel."""
+    bh, t, dh = qr.shape
+    kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
+                               block_q=bq, block_k=bk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, t // bq, t // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, dh), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, dh), lambda b, i, j: (b, i, 0)),
+            # (bh, 1, t) layout so the block's last-two dims satisfy the
+            # TPU (8, 128) tiling rule (second-to-last == array dim == 1)
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, dh), qr.dtype),
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bq, dh), jnp.float32),
+                        pltpu.VMEM((bq, 128), jnp.float32),
+                        pltpu.VMEM((bq, 128), jnp.float32)],
+        interpret=_interpret(),
+    )(qr, kr, vr)
+    return out, lse
 
-    bq = min(block_q, t)
-    bk = min(block_k, t)
+
+# ---------------------------------------------------------------------------
+# backward (Dao 2022 recurrence; P recomputed blockwise from L)
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref, dq_ref,
+                   dq_acc, *, causal: bool, scale: float, block_q: int,
+                   block_k: int):
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    n_kb = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = _dot_t(q, k) * scale
+        p = jnp.exp(s - lse_ref[0, 0][:, None])
+        if causal:
+            mask = _causal_mask(qi, kb, block_q, block_k, s.shape)
+            p = jnp.where(mask, p, 0.0)
+        dp = _dot_t(do, v)
+        ds = p * (dp - dvec_ref[0, 0][:, None]) * scale
+        dq_acc[:] = dq_acc[:] + _dot(ds, k)
+
+    if causal:
+        pl.when(kb * block_k <= qi * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kb == n_kb - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dvec_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
+                    scale: float, block_q: int, block_k: int):
+    kb = pl.program_id(1)
+    qj = pl.program_id(2)
+    n_qb = pl.num_programs(2)
+
+    @pl.when(qj == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = _dot_t(q, k) * scale                      # (BQ, BK)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])
+        if causal:
+            mask = _causal_mask(qj, kb, block_q, block_k, s.shape)
+            p = jnp.where(mask, p, 0.0)
+        # dV += P^T dO ; dS = P∘(dO V^T − D) ; dK += dS^T Q
+        dv_acc[:] = dv_acc[:] + _dot(p.T, do)
+        dp = _dot_t(do, v)
+        ds = p * (dp - dvec_ref[0, 0][:, None]) * scale
+        dk_acc[:] = dk_acc[:] + _dot(ds.T, q)
+
+    if causal:
+        # skip q blocks entirely ABOVE this k block's diagonal
+        pl.when(qj * block_q + block_q - 1 >= kb * block_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qj == n_qb - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_raw(qr, kr, vr, do, lse, dvec, *, causal, bq, bk, scale):
+    bh, t, dh = qr.shape
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
+                          block_q=bq, block_k=bk),
+        grid=(bh, t // bq, t // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, dh), lambda b, i, j: (b, i, 0)),  # q
+            pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, j, 0)),  # k
+            pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, j, 0)),  # v
+            pl.BlockSpec((1, bq, dh), lambda b, i, j: (b, i, 0)),  # do
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),   # lse
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),   # dvec
+        ],
+        out_specs=pl.BlockSpec((1, bq, dh), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, dh), qr.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, dh), jnp.float32)],
+        interpret=_interpret(),
+    )(qr, kr, vr, do, lse, dvec)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
+                          block_q=bq, block_k=bk),
+        grid=(bh, t // bk, t // bq),
+        in_specs=[
+            pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, i, 0)),  # k
+            pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, i, 0)),  # v
+            pl.BlockSpec((1, bq, dh), lambda b, i, j: (b, j, 0)),  # q
+            pl.BlockSpec((1, bq, dh), lambda b, i, j: (b, j, 0)),  # do
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, j)),   # lse
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, j)),   # dvec
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((bh, t, dh), kr.dtype),
+                   jax.ShapeDtypeStruct((bh, t, dh), vr.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, dh), jnp.float32),
+                        pltpu.VMEM((bk, dh), jnp.float32)],
+        interpret=_interpret(),
+    )(kr, vr, qr, do, lse, dvec)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def _to_bh(x):
+    b, t, h, dh = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, dh)
+
+
+def _from_bh(x, b, h):
+    bh, t, dh = x.shape
+    return x.reshape(b, h, t, dh).transpose(0, 2, 1, 3)
+
+
+def _blocks(t, block_q, block_k):
+    bq, bk = min(block_q, t), min(block_k, t)
     if t % bq or t % bk:
         raise ValueError(f"sequence length {t} must divide block sizes "
                          f"({bq}, {bk})")
-
-    if not _HAS_PLTPU:  # pragma: no cover
-        raise RuntimeError("pallas TPU module unavailable; use "
-                           "dot_product_attention")
-    kernel = functools.partial(_attn_kernel, causal=causal, scale=scale,
-                               block_q=bq, block_k=bk)
-    scratch = [pltpu.VMEM((bq, dh), jnp.float32),
-               pltpu.VMEM((bq, 128), jnp.float32),
-               pltpu.VMEM((bq, 128), jnp.float32)]
-    out = pl.pallas_call(
-        kernel,
-        grid=(b * h, t // bq, t // bk),
-        in_specs=[
-            pl.BlockSpec((1, bq, dh), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, bk, dh), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, bk, dh), lambda bh, i, j: (bh, j, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, bq, dh), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, dh), q.dtype),
-        scratch_shapes=scratch,
-        interpret=interpret,
-    )(qr, kr, vr)
-    return out.reshape(b, h, t, dh).transpose(0, 2, 1, 3)
+    return bq, bk
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -135,26 +291,41 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
                     block_k: int = 128):
     """Pallas flash attention; q/k/v (B, T, H, Dh) → (B, T, H, Dh).
 
-    Numerically equal to ``dot_product_attention`` (tested); O(T·D) HBM
-    traffic, VMEM bounded by block sizes.  Interpret mode is selected
-    automatically off TPU.
+    Numerically equal to ``dot_product_attention`` (tested, gradients
+    included); O(T·D) HBM traffic on BOTH forward and backward (the
+    backward kernels recompute P blockwise from the saved logsumexp).
+    Interpret mode is selected automatically off TPU.
     """
-    interpret = jax.default_backend() != "tpu" or not _HAS_PLTPU
-    return _flash_fwd(q, k, v, causal=causal, block_q=block_q,
-                      block_k=block_k, interpret=interpret)
+    out, _ = _vjp_fwd(q, k, v, causal, block_q, block_k)
+    return out
 
 
 def _vjp_fwd(q, k, v, causal, block_q, block_k):
-    out = flash_attention(q, k, v, causal, block_q, block_k)
-    return out, (q, k, v)
+    if not _HAS_PLTPU:  # pragma: no cover
+        raise RuntimeError("pallas TPU module unavailable; use "
+                           "dot_product_attention")
+    b, t, h, dh = q.shape
+    bq, bk = _blocks(t, block_q, block_k)
+    scale = 1.0 / math.sqrt(dh)
+    out, lse = _flash_fwd_raw(_to_bh(q), _to_bh(k), _to_bh(v),
+                              causal=causal, bq=bq, bk=bk, scale=scale)
+    return _from_bh(out, b, h), (q, k, v, out, lse)
 
 
 def _vjp_bwd(causal, block_q, block_k, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: dot_product_attention(q, k, v, causal=causal),
-        q, k, v)
-    return vjp(g)
+    q, k, v, out_bh, lse = res
+    b, t, h, dh = q.shape
+    bq, bk = _blocks(t, block_q, block_k)
+    scale = 1.0 / math.sqrt(dh)
+    do = _to_bh(g.astype(jnp.float32))
+    # D_i = rowsum(dO_i ∘ O_i) — the softmax-grad correction term
+    dvec = jnp.sum(do * out_bh.astype(jnp.float32), axis=-1)[:, None, :]
+    dq, dk, dv = _flash_bwd_raw(_to_bh(q), _to_bh(k), _to_bh(v), do, lse,
+                                dvec, causal=causal, bq=bq, bk=bk,
+                                scale=scale)
+    return (_from_bh(dq, b, h).astype(q.dtype),
+            _from_bh(dk, b, h).astype(k.dtype),
+            _from_bh(dv, b, h).astype(v.dtype))
 
 
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
