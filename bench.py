@@ -7,6 +7,13 @@ repo's own recorded anchor (BENCH_ANCHOR.json, written on first run), so
 ``vs_baseline`` tracks our progress against the first measured
 implementation — exactly the "beat your own SingleTrainer anchor"
 methodology SURVEY.md §6 prescribes.
+
+Measured through the PUBLIC trainer API: ``SingleTrainer(...,
+compute_dtype="bfloat16")`` — the same path a user reaches, not a
+bench-only harness.  Timing is honest: each epoch ends with a
+device->host loss readback inside the trainer (np.asarray on the scan
+output), which waits for compute; ``block_until_ready`` alone returns at
+schedule time through the axon tunnel and would measure dispatch only.
 """
 
 import json
@@ -17,61 +24,44 @@ import time
 ROOT = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, ROOT)
 
-import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
+from distkeras_tpu.data.dataset import Dataset  # noqa: E402
 from distkeras_tpu.models import zoo  # noqa: E402
-from distkeras_tpu.ops.losses import categorical_crossentropy_from_probs  # noqa: E402
-from distkeras_tpu.ops.optimizers import get_optimizer  # noqa: E402
-from distkeras_tpu.parallel.sync import make_window_fn  # noqa: E402
+from distkeras_tpu.trainers import SingleTrainer  # noqa: E402
 
 BATCH = int(os.environ.get("BENCH_BATCH", 1024))
-STEPS_PER_CALL = 32
-WARMUP_CALLS = 2
-TIMED_CALLS = int(os.environ.get("BENCH_CALLS", 4))
+STEPS_PER_EPOCH = 32
+WARMUP_EPOCHS = 2
+TIMED_EPOCHS = int(os.environ.get("BENCH_CALLS", 4))
 ANCHOR_PATH = os.path.join(ROOT, "BENCH_ANCHOR.json")
 
 
 def main():
-    model = zoo.resnet20()
-    optimizer = get_optimizer("sgd", 0.1)
-    # bfloat16 activations: params stay f32, layers cast to input dtype,
-    # so the convs/matmuls hit the MXU in bf16.
-    run = make_window_fn(model, categorical_crossentropy_from_probs,
-                         optimizer, compute_dtype=jnp.bfloat16)
-
     rng = np.random.default_rng(0)
-    xs = rng.random((STEPS_PER_CALL, BATCH, 32, 32, 3), dtype=np.float32)
-    labels = rng.integers(0, 10, size=(STEPS_PER_CALL, BATCH))
-    ys = np.eye(10, dtype=np.float32)[labels]
-    xs, ys = jnp.asarray(xs), jnp.asarray(ys)
+    n_rows = STEPS_PER_EPOCH * BATCH
+    labels = rng.integers(0, 10, size=n_rows)
+    ds = Dataset({
+        "features": rng.random((n_rows, 32, 32, 3), dtype=np.float32),
+        "label": np.eye(10, dtype=np.float32)[labels],
+    })
 
-    variables = model.init(0)
-    opt_state = optimizer.init(variables["params"])
-    key = jax.random.PRNGKey(1)
+    trainer = SingleTrainer(
+        zoo.resnet20(), "sgd", "categorical_crossentropy",
+        features_col="features", label_col="label",
+        num_epoch=WARMUP_EPOCHS + TIMED_EPOCHS, batch_size=BATCH,
+        learning_rate=0.1, compute_dtype="bfloat16")
+    trainer.train(ds)
 
-    for _ in range(WARMUP_CALLS):
-        variables, opt_state, key, losses = run(variables, opt_state, key,
-                                                xs, ys)
-    float(losses[-1])  # hard sync: a device->host read must wait for compute
-    # (block_until_ready alone returns at schedule time through the axon
-    # tunnel and measures dispatch, not execution)
-
-    t0 = time.perf_counter()
-    for _ in range(TIMED_CALLS):
-        variables, opt_state, key, losses = run(variables, opt_state, key,
-                                                xs, ys)
-    float(losses[-1])  # hard sync
-    dt = time.perf_counter() - t0
-
-    # the window scan is a plain single-device jit: per-chip == total here
-    samples = TIMED_CALLS * STEPS_PER_CALL * BATCH
-    sps_chip = samples / dt
+    epochs = [r for r in trainer.metrics.records if r["event"] == "epoch"]
+    timed = epochs[WARMUP_EPOCHS:]
+    samples = STEPS_PER_EPOCH * BATCH * len(timed)
+    # the epoch program is a plain single-device jit: per-chip == total here
+    sps_chip = samples / sum(r["epoch_seconds"] for r in timed)
 
     # anchor is keyed by config so overriding BENCH_BATCH can't masquerade
     # as a regression against an incompatible workload
-    cfg_key = f"b{BATCH}_s{STEPS_PER_CALL}"
+    cfg_key = f"b{BATCH}_s{STEPS_PER_EPOCH}"
     anchors = {}
     if os.path.exists(ANCHOR_PATH):
         with open(ANCHOR_PATH) as f:

@@ -32,7 +32,8 @@ def run_async_training(trainer, dataset, fault_injector=None):
     the worker flavor (``_async_mode`` attribute).
     """
     loss_fn, optimizer = trainer._resolve()
-    window_fn = make_window_fn(trainer.model, loss_fn, optimizer)
+    window_fn = make_window_fn(trainer.model, loss_fn, optimizer,
+                               compute_dtype=trainer.compute_dtype)
     mode = getattr(trainer, "_async_mode", "pull_commit")
     worker_cls = _WORKER_CLASSES[mode]
 

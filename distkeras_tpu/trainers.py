@@ -44,6 +44,17 @@ from .utils.checkpoint import CheckpointManager
 from .utils.metrics import MetricsLogger
 
 
+def _resolve_dtype(dtype):
+    """None | str | dtype -> numpy dtype (or None).  Accepts the common
+    shorthands so ``compute_dtype="bf16"`` works."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        dtype = {"bf16": "bfloat16", "fp16": "float16",
+                 "f32": "float32", "fp32": "float32"}.get(dtype, dtype)
+    return jnp.dtype(dtype)
+
+
 def _ends_in_prob_activation(model) -> bool:
     """Reference models end in a softmax (or sigmoid, for binary heads)
     layer and train with crossentropy on probabilities (Keras semantics).
@@ -78,7 +89,8 @@ class Trainer:
                  label_col: str = "label", num_epoch: int = 1,
                  batch_size: int = 32, learning_rate: float = 0.01,
                  seed: int = 0, checkpoint_dir: Optional[str] = None,
-                 checkpoint_keep: int = 3, metrics=None):
+                 checkpoint_keep: int = 3, metrics=None,
+                 compute_dtype=None):
         self.model = keras_model
         self.worker_optimizer = worker_optimizer
         self.loss = loss
@@ -90,6 +102,11 @@ class Trainer:
         self.seed = int(seed)
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_keep = int(checkpoint_keep)
+        #: mixed precision: cast activations to this dtype in the train
+        #: step (params/optimizer state stay f32 — layers cast weights to
+        #: the activation dtype at use, so matmuls/convs hit the MXU in
+        #: e.g. bfloat16 while the master copy keeps full precision).
+        self.compute_dtype = _resolve_dtype(compute_dtype)
         if metrics is None or isinstance(metrics, MetricsLogger):
             self.metrics = metrics or MetricsLogger(None)
         else:
@@ -183,7 +200,8 @@ class SingleTrainer(Trainer):
         if shuffle:
             dataset = dataset.shuffle(self.seed)
         loss_fn, optimizer = self._resolve()
-        run = make_window_fn(self.model, loss_fn, optimizer)
+        run = make_window_fn(self.model, loss_fn, optimizer,
+                             compute_dtype=self.compute_dtype)
 
         ds = dataset.coalesce(1)
         stacked, steps = ds.stacked([self.features_col, self.label_col],
@@ -292,7 +310,8 @@ class DistributedTrainer(Trainer):
             self.num_workers)
         engine = SyncEngine(self.model, loss_fn, optimizer,
                             self._sync_algorithm(), self.num_workers,
-                            self.communication_window, mesh=mesh)
+                            self.communication_window, mesh=mesh,
+                            compute_dtype=self.compute_dtype)
         run = engine.epoch_fn()
         P = self.num_workers
 
@@ -384,7 +403,7 @@ class EnsembleTrainer(DistributedTrainer):
             self.num_workers)
         engine = SyncEngine(self.model, loss_fn, optimizer, NoCommSync(),
                             self.num_workers, self.communication_window,
-                            mesh=mesh)
+                            mesh=mesh, compute_dtype=self.compute_dtype)
         run = engine.epoch_fn()
         P = self.num_workers
 
@@ -452,7 +471,8 @@ class SpmdTrainer(Trainer):
         mesh = mesh_lib.make_mesh(axis_names=axes, shape=sizes)
         dp = "dp" if "dp" in axes else axes[0]
 
-        run = make_window_fn(self.model, loss_fn, optimizer)
+        run = make_window_fn(self.model, loss_fn, optimizer,
+                             compute_dtype=self.compute_dtype)
 
         ds = dataset.coalesce(1)
         stacked, steps = ds.stacked([self.features_col, self.label_col],

@@ -20,10 +20,14 @@ class MetricsLogger:
 
     ``MetricsLogger("train.jsonl")`` or ``MetricsLogger(sys.stdout)``;
     ``log(event, **fields)`` writes one line with a wall-clock timestamp.
+    Every record is also kept in ``.records`` so callers (benchmarks,
+    notebooks) can read trainer-emitted metrics back without parsing the
+    sink — records are per-epoch, so the list stays small.
     """
 
     def __init__(self, sink: Union[str, IO, None] = None):
         self._own = False
+        self.records: list = []
         if sink is None:
             self._fh = None
         elif isinstance(sink, str):
@@ -34,6 +38,7 @@ class MetricsLogger:
 
     def log(self, event: str, **fields) -> dict:
         rec = {"ts": time.time(), "event": event, **fields}
+        self.records.append(rec)
         if self._fh is not None:
             self._fh.write(json.dumps(rec, default=float) + "\n")
         return rec

@@ -44,28 +44,81 @@ def ds():
     return toy_problem()
 
 
-def test_single_trainer_anchor(ds):
+@pytest.fixture(scope="module")
+def anchor_acc(ds):
+    """SingleTrainer accuracy on the toy problem — the conformance anchor
+    every distributed trainer is held to (reference: the workflow notebook
+    compares all trainers against the single-worker result)."""
     t = dk.SingleTrainer(make_model(), "sgd", **COMMON)
     m = t.train(ds)
-    assert accuracy(m, ds) > 0.9
+    acc = accuracy(m, ds)
+    assert acc > 0.9
     assert t.get_training_time() > 0
     assert len(t.get_history()) == COMMON["num_epoch"]
     assert t.get_averaged_history()[-1] < t.get_averaged_history()[0]
+    return acc
 
 
-@pytest.mark.parametrize("cls,kw,floor", [
-    (dk.ADAG, dict(communication_window=4), 0.55),
-    (dk.DOWNPOUR, dict(communication_window=4), 0.9),
-    (dk.DynSGD, dict(communication_window=4), 0.9),
-    (dk.AEASGD, dict(communication_window=4, rho=1.0), 0.5),
-    (dk.EAMSGD, dict(communication_window=4, rho=1.0, momentum=0.9), 0.8),
-    (dk.AveragingTrainer, {}, 0.55),
+def test_single_trainer_anchor(anchor_acc):
+    assert anchor_acc > 0.9
+
+
+# (cls, kwargs, extra epochs over COMMON, allowed accuracy gap vs anchor).
+# Workers see 1/8 of the data each, so the averaging-style algorithms
+# (ADAG / AEASGD / AveragingTrainer) legitimately need more epochs to
+# approach the anchor; the gap bounds are tight enough that a broken
+# communicate() rule (e.g. dropping the collective) fails the test.
+@pytest.mark.parametrize("cls,kw,epochs,gap", [
+    (dk.ADAG, dict(communication_window=4), 12, 0.10),
+    (dk.DOWNPOUR, dict(communication_window=4), None, 0.05),
+    (dk.DynSGD, dict(communication_window=4), None, 0.05),
+    (dk.AEASGD, dict(communication_window=4, rho=1.0), 12, 0.12),
+    (dk.EAMSGD, dict(communication_window=4, rho=1.0, momentum=0.9),
+     None, 0.08),
+    (dk.AveragingTrainer, {}, 12, 0.10),
 ])
-def test_distributed_trainers(ds, cls, kw, floor):
-    t = cls(make_model(), "sgd", num_workers=8, **COMMON, **kw)
+def test_distributed_trainers(ds, anchor_acc, cls, kw, epochs, gap):
+    common = dict(COMMON, num_epoch=epochs) if epochs else COMMON
+    t = cls(make_model(), "sgd", num_workers=8, **common, **kw)
     m = t.train(ds)
-    assert accuracy(m, ds) > floor
+    assert accuracy(m, ds) > anchor_acc - gap
     assert t.get_history()[0].shape[0] == 8  # per-worker loss history
+
+
+def test_bf16_compute_dtype_converges(ds, anchor_acc):
+    """compute_dtype='bfloat16' through the public trainer API: activations
+    train in bf16 (params stay f32) and accuracy matches the f32 anchor."""
+    t = dk.SingleTrainer(make_model(), "sgd", compute_dtype="bfloat16",
+                         **COMMON)
+    acc = accuracy(t.train(ds), ds)
+    assert abs(acc - anchor_acc) < 0.03
+
+    d = dk.ADAG(make_model(), "sgd", num_workers=8, communication_window=4,
+                compute_dtype="bfloat16", **dict(COMMON, num_epoch=12))
+    dacc = accuracy(d.train(ds), ds)
+    assert dacc > anchor_acc - 0.10
+
+
+def test_bitwise_determinism(ds):
+    """SURVEY.md §4 item 4: sync trainers are bitwise-reproducible under a
+    fixed PRNG seed — same config twice gives IDENTICAL parameters."""
+    import jax
+
+    def params(trainer):
+        m = trainer.train(ds)
+        return jax.tree_util.tree_leaves(m.variables["params"])
+
+    a = params(dk.SingleTrainer(make_model(), "sgd", seed=3, **COMMON))
+    b = params(dk.SingleTrainer(make_model(), "sgd", seed=3, **COMMON))
+    for x, y in zip(a, b):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+    c = params(dk.ADAG(make_model(), "sgd", num_workers=8, seed=3,
+                       communication_window=4, **COMMON))
+    d = params(dk.ADAG(make_model(), "sgd", num_workers=8, seed=3,
+                       communication_window=4, **COMMON))
+    for x, y in zip(c, d):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
 
 
 def test_ensemble_trainer(ds):
