@@ -10,10 +10,19 @@ methodology SURVEY.md §6 prescribes.
 
 Measured through the PUBLIC trainer API: ``SingleTrainer(...,
 compute_dtype="bfloat16")`` — the same path a user reaches, not a
-bench-only harness.  Timing is honest: each epoch ends with a
-device->host loss readback inside the trainer (np.asarray on the scan
-output), which waits for compute; ``block_until_ready`` alone returns at
-schedule time through the axon tunnel and would measure dispatch only.
+bench-only harness.  Timing is honest: the trainer pipelines epochs
+(epoch k's loss readback happens after epoch k+1 is dispatched) but every
+epoch's wall time is marked at the completion of its own device->host
+loss readback, and the final epoch is fully drained before the clock
+stops — so sum(epoch_seconds) spans dispatch start → last epoch's compute
+actually done.  ``block_until_ready`` alone returns at schedule time
+through the axon tunnel and would measure dispatch only; readback is the
+only honest fence.
+
+The anchor value is the round-1 first-measured throughput on this same
+workload+metric (end-to-end samples/sec with a hard final sync); the
+harness version that produced each number is recorded alongside so
+methodology changes are visible (HARNESS below).
 """
 
 import json
@@ -35,6 +44,11 @@ STEPS_PER_EPOCH = 32
 WARMUP_EPOCHS = 2
 TIMED_EPOCHS = int(os.environ.get("BENCH_CALLS", 4))
 ANCHOR_PATH = os.path.join(ROOT, "BENCH_ANCHOR.json")
+#: bench methodology version (ADVICE r2: record it so a harness change can
+#: never masquerade as a perf change): v1 = raw window-fn timing (r1),
+#: v2 = SingleTrainer with per-epoch blocking readback (r2),
+#: v3 = SingleTrainer with pipelined epochs + final drain (r3).
+HARNESS = "trainer_pipelined_v3"
 
 
 def main():
@@ -67,16 +81,18 @@ def main():
         with open(ANCHOR_PATH) as f:
             anchors = json.load(f)
     if cfg_key not in anchors:
-        anchors[cfg_key] = sps_chip
+        anchors[cfg_key] = {"value": sps_chip, "harness": HARNESS}
         with open(ANCHOR_PATH, "w") as f:
             json.dump(anchors, f, indent=1)
-    anchor = anchors[cfg_key]
+    entry = anchors[cfg_key]  # legacy anchors are bare floats
+    anchor = entry["value"] if isinstance(entry, dict) else entry
 
     print(json.dumps({
         "metric": "samples/sec/chip (CIFAR-10 ResNet-20)",
         "value": round(sps_chip, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": round(sps_chip / anchor, 4),
+        "harness": HARNESS,
     }))
 
 

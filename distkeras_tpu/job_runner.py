@@ -15,7 +15,6 @@ import numpy as np
 from . import trainers as trainers_mod
 from .data import datasets as datasets_mod
 from .data.dataset import Dataset
-from .models.model import Model
 from .utils import serde
 
 
@@ -34,7 +33,9 @@ def run_package(pkg_path: str, out_path: str) -> None:
     with open(pkg_path, "rb") as f:
         pkg = serde.tree_from_bytes(f.read())
 
-    model = Model.from_config(json.loads(pkg["model_config"]))
+    # serde's dispatch: native Model configs AND ingested KerasAdapter
+    # configs both rebuild correctly
+    model = serde.model_from_config(json.loads(pkg["model_config"]))
     cls = getattr(trainers_mod, pkg["trainer"]["class"])
     trainer = cls(model, **pkg["trainer"].get("kwargs", {}))
     ds = _load_dataset(pkg["dataset"])

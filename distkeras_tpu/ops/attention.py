@@ -37,6 +37,9 @@ def dot_product_attention(q, k, v, *, causal: bool = False):
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 
+_MIN_FLASH_BLOCK = 32  # below this the kernel grid degenerates (perf cliff)
+
+
 def _largest_divisor_block(t: int, cap: int = 128) -> int:
     """Largest block size ≤ cap dividing t (flash kernels need whole
     blocks; T=200 → 100, T=256 → 128, prime T → 1)."""
@@ -46,18 +49,45 @@ def _largest_divisor_block(t: int, cap: int = 128) -> int:
     return 1
 
 
+def _flash_with_blocking(q, k, v, causal: bool, t: int):
+    """Run the Pallas flash kernel with a sane block size.
+
+    Awkward sequence lengths (e.g. prime T) have no block-sized divisor;
+    silently falling back to block=1 is a severe perf cliff on real TPU.
+    For causal attention, end-padding T to a multiple of 128 is exact:
+    padded KEY positions sit strictly after every real query (never
+    attended), and padded QUERY rows are sliced off (their zero cotangent
+    keeps gradients exact too).  Non-causal attention would attend the
+    padded keys, so there we refuse loudly instead of degrading.
+    """
+    from .pallas_attention import flash_attention
+    blk = _largest_divisor_block(t)
+    if blk >= _MIN_FLASH_BLOCK or t <= _MIN_FLASH_BLOCK:
+        return flash_attention(q, k, v, causal, blk, blk)
+    if not causal:
+        raise ValueError(
+            f"impl='flash' needs a sequence length with a block-sized "
+            f"divisor; T={t}'s largest block is {blk} (< "
+            f"{_MIN_FLASH_BLOCK}), which would run the kernel grid "
+            f"degenerately slowly.  Pad T to a multiple of 128 (with key "
+            f"masking) or use impl='dense'.")
+    pad = -t % 128
+    padded = [jnp.pad(a, ((0, 0), (0, pad), (0, 0), (0, 0))) for a in (q, k, v)]
+    return flash_attention(*padded, True, 128, 128)[:, :t]
+
+
 @register
 class MultiHeadAttention(Layer):
     """Self-attention over (T, D) inputs; fused qkv projection (one
     MXU-shaped (D, 3D) GEMM) + output projection.
 
     ``impl``: ``"dense"`` (XLA-fused reference) or ``"flash"`` (the Pallas
-    VMEM-resident kernel, ``ops.pallas_attention``).  Flash gives O(T·D)
-    HBM traffic on the FORWARD only — its backward currently recomputes
-    through the dense formulation (O(T²) memory), so for long-context
-    TRAINING the sequence-parallel path (``parallel.ring``) is the one
-    that scales; flash shines for long-context inference and short-to-mid
-    training sequences.
+    VMEM-resident kernels, ``ops.pallas_attention``: fused forward AND
+    backward, both O(T·D) HBM — the forward saves only O and the per-row
+    logsumexp, dQ/dK/dV recompute scores blockwise).  Flash scales a
+    single chip to HBM-limited sequence lengths for training and
+    inference; past one chip, the sequence-parallel ring path
+    (``parallel.ring``) shards T across devices.
     """
 
     def __init__(self, num_heads: int, causal: bool = False,
@@ -90,9 +120,7 @@ class MultiHeadAttention(Layer):
         k = k.reshape(b, t, h, dh)
         v = v.reshape(b, t, h, dh)
         if self.impl == "flash":
-            from .pallas_attention import flash_attention
-            blk = _largest_divisor_block(t)
-            o = flash_attention(q, k, v, self.causal, blk, blk)
+            o = _flash_with_blocking(q, k, v, self.causal, t)
         else:
             o = dot_product_attention(q, k, v, causal=self.causal)
         o = o.reshape(b, t, d)

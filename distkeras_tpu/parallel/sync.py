@@ -58,6 +58,22 @@ def tree_scale(a, s):
     return tmap(lambda x: x * s, a)
 
 
+def _inexact(x) -> bool:
+    """Communication rules act on floating-point leaves only: integer/bool
+    variable state (Keras SeedGenerator counters, step counters, ...) has no
+    meaningful average/sum and must keep its dtype and worker-local value
+    across window edges.  Works on jnp and np leaves alike — the async PS
+    (``ps.servers`` / ``ps.workers``) shares this predicate."""
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+
+
+def adopt_float_leaves(source: Tree, local: Tree) -> Tree:
+    """``local`` with its floating leaves replaced by ``source``'s; integer/
+    bool leaves keep the local value (see ``_inexact``).  The single merge
+    rule for every window-edge/pull site (sync algorithms, async workers)."""
+    return tmap(lambda s, l: s if _inexact(l) else l, source, local)
+
+
 def _squeeze0(tree):
     return tmap(lambda x: x[0], tree)
 
@@ -170,8 +186,10 @@ class AdagSync(SyncAlgorithm):
     name = "adag"
 
     def communicate(self, center, local, axis):
-        new_center = tmap(lambda l: lax.pmean(l, axis), local)
-        return new_center, new_center
+        new_center = tmap(
+            lambda c, l: lax.pmean(l, axis) if _inexact(l) else c,
+            center, local)
+        return new_center, adopt_float_leaves(new_center, local)
 
 
 class DownpourSync(SyncAlgorithm):
@@ -183,9 +201,10 @@ class DownpourSync(SyncAlgorithm):
     name = "downpour"
 
     def communicate(self, center, local, axis):
-        delta = tmap(lambda l, c: lax.psum(l - c, axis), local, center)
-        new_center = tree_add(center, delta)
-        return new_center, new_center
+        new_center = tmap(
+            lambda c, l: c + lax.psum(l - c, axis) if _inexact(l) else c,
+            center, local)
+        return new_center, adopt_float_leaves(new_center, local)
 
 
 class DynSgdSync(SyncAlgorithm):
@@ -199,9 +218,11 @@ class DynSgdSync(SyncAlgorithm):
 
     def communicate(self, center, local, axis):
         scale = 1.0 / (self.staleness + 1)
-        delta = tmap(lambda l, c: lax.psum((l - c) * scale, axis), local, center)
-        new_center = tree_add(center, delta)
-        return new_center, new_center
+        new_center = tmap(
+            lambda c, l: c + lax.psum((l - c) * scale, axis)
+            if _inexact(l) else c,
+            center, local)
+        return new_center, adopt_float_leaves(new_center, local)
 
 
 class EasgdSync(SyncAlgorithm):
@@ -220,9 +241,13 @@ class EasgdSync(SyncAlgorithm):
         self.alpha = float(alpha)
 
     def communicate(self, center, local, axis):
-        elastic = tmap(lambda l, c: self.alpha * (l - c), local, center)
-        new_local = tree_sub(local, elastic)
-        new_center = tree_add(center, tmap(lambda e: lax.psum(e, axis), elastic))
+        new_center = tmap(
+            lambda c, l: c + lax.psum(self.alpha * (l - c), axis)
+            if _inexact(l) else c,
+            center, local)
+        new_local = tmap(
+            lambda c, l: l - self.alpha * (l - c) if _inexact(l) else l,
+            center, local)
         return new_center, new_local
 
 

@@ -21,7 +21,7 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
-from ..parallel.sync import tmap as _tree_map
+from ..parallel.sync import _inexact, tmap as _tree_map
 from ..utils import native
 from .networking import recv_msg, send_msg
 
@@ -32,10 +32,15 @@ def _tree_fused_add(center: Tree, delta: Tree, scale: float) -> Tree:
     """center + scale·delta leaf-wise via the native data plane
     (``native/dknative.cpp``) — one fused multithreaded pass per leaf, GIL
     released; NumPy fallback.  Returns NEW arrays (replace semantics keep
-    the lock-free pull/checkpoint snapshots race-free)."""
-    return _tree_map(lambda c, d: native.fused_add(np.asarray(c),
-                                                   np.asarray(d), scale),
-                     center, delta)
+    the lock-free pull/checkpoint snapshots race-free).
+
+    Floating leaves only: integer/bool variable state (e.g. Keras
+    SeedGenerator counters) has no meaningful delta arithmetic — the
+    center keeps its value (mirrors the sync engine's window-edge rule)."""
+    return _tree_map(
+        lambda c, d: native.fused_add(np.asarray(c), np.asarray(d), scale)
+        if _inexact(c) else np.asarray(c),
+        center, delta)
 
 
 class ParameterServer:

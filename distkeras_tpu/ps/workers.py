@@ -27,7 +27,7 @@ from typing import Any, Callable, Optional
 import jax
 import numpy as np
 
-from ..parallel.sync import tmap as _tmap
+from ..parallel.sync import _inexact, adopt_float_leaves, tmap as _tmap
 from .client import PSClient
 
 Tree = Any
@@ -35,6 +35,13 @@ Tree = Any
 
 def _host(tree):
     return _tmap(np.asarray, tree)
+
+
+def _merge_pull(local, center):
+    """Adopt the pulled center's floating leaves; keep worker-local
+    integer/bool state (RNG counters stay decorrelated across workers —
+    same rule as the sync engine's window edge)."""
+    return adopt_float_leaves(center, local)
 
 
 class AsyncWorker(threading.Thread):
@@ -102,7 +109,7 @@ class PullCommitWorker(AsyncWorker):
 
     def _window(self, client, wx, wy):
         center, _ = client.pull()
-        self.variables = self._put(center)
+        self.variables = self._put(_merge_pull(_host(self.variables), center))
         losses = self._run_window(wx, wy)
         after = _host(self.variables)
         delta = _tmap(lambda a, c: a - np.asarray(c), after, center)
@@ -116,7 +123,7 @@ class StalenessWorker(AsyncWorker):
 
     def _window(self, client, wx, wy):
         center, seen_updates = client.pull()
-        self.variables = self._put(center)
+        self.variables = self._put(_merge_pull(_host(self.variables), center))
         losses = self._run_window(wx, wy)
         after = _host(self.variables)
         delta = _tmap(lambda a, c: a - np.asarray(c), after, center)
@@ -136,8 +143,12 @@ class ElasticWorker(AsyncWorker):
         losses = self._run_window(wx, wy)
         center, _ = client.pull()
         local = _host(self.variables)
-        elastic = _tmap(lambda l, c: self.alpha * (l - np.asarray(c)),
-                        local, center)
+        # elastic force on floating leaves only; integer/bool state (RNG
+        # counters) commits a zero delta (the server skips it anyway) and
+        # stays worker-local, dtype intact
+        elastic = _tmap(
+            lambda l, c: self.alpha * (l - np.asarray(c)) if _inexact(l)
+            else np.zeros_like(l), local, center)
         self.variables = self._put(
             _tmap(lambda l, e: l - e, local, elastic))
         client.commit(elastic)

@@ -8,6 +8,7 @@ a ``jax.profiler`` trace context for TensorBoard/Perfetto captures.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import sys
@@ -20,14 +21,18 @@ class MetricsLogger:
 
     ``MetricsLogger("train.jsonl")`` or ``MetricsLogger(sys.stdout)``;
     ``log(event, **fields)`` writes one line with a wall-clock timestamp.
-    Every record is also kept in ``.records`` so callers (benchmarks,
-    notebooks) can read trainer-emitted metrics back without parsing the
-    sink — records are per-epoch, so the list stays small.
+    The most recent ``keep_records`` records are also kept in ``.records``
+    so callers (benchmarks, notebooks) can read trainer-emitted metrics
+    back without parsing the sink; the cap keeps memory bounded even if a
+    long-lived service logs per-step events (the sink, if any, still gets
+    every record).
     """
 
-    def __init__(self, sink: Union[str, IO, None] = None):
+    def __init__(self, sink: Union[str, IO, None] = None,
+                 keep_records: int = 100_000):
         self._own = False
-        self.records: list = []
+        self.records: collections.deque = collections.deque(
+            maxlen=keep_records)
         if sink is None:
             self._fh = None
         elif isinstance(sink, str):

@@ -74,18 +74,25 @@ def serialize_model(model, variables: Any = None) -> bytes:
     return tree_to_bytes(payload)
 
 
+def model_from_config(cfg: dict):
+    """Rebuild a model from its config dict, dispatching on flavor: native
+    configs go through ``models.Model``, ingested Keras-3 configs (marked
+    by their ``keras_json`` key) through ``KerasAdapter``.  The single
+    dispatch point for every consumer of serialized configs (serde, job
+    runner)."""
+    from ..models.model import Model
+    if "keras_json" in cfg:
+        from ..models.keras_adapter import KerasAdapter
+        return KerasAdapter.from_config(cfg)
+    return Model.from_config(cfg)
+
+
 def deserialize_model(data: bytes):
     """Returns ``(model, variables)``; variables is None if not saved.
 
     Handles both native configs (``models.Model``) and ingested Keras-3
     models (``models.keras_adapter.KerasAdapter``).
     """
-    from ..models.model import Model
     payload = tree_from_bytes(data)
-    cfg = json.loads(payload["arch"])
-    if "keras_json" in cfg:
-        from ..models.keras_adapter import KerasAdapter
-        model = KerasAdapter.from_config(cfg)
-    else:
-        model = Model.from_config(cfg)
+    model = model_from_config(json.loads(payload["arch"]))
     return model, payload.get("variables")

@@ -110,3 +110,24 @@ def test_step_timer():
     st = StepTimer()
     st.mark()
     assert st.rate(100) > 0
+
+
+def test_ensemble_checkpoint_resume_and_metrics(ds, tmp_path):
+    """EnsembleTrainer used to silently no-op checkpoint_dir and metrics."""
+    cdir = str(tmp_path / "ck")
+    buf = io.StringIO()
+    t1 = dk.EnsembleTrainer(make_model(), "sgd", num_ensembles=8,
+                            **{**COMMON, "num_epoch": 1}, seed=3,
+                            checkpoint_dir=cdir, metrics=MetricsLogger(buf))
+    t1.train(ds)
+    assert CheckpointManager(cdir).latest_step() is not None
+    epochs = [json.loads(l) for l in buf.getvalue().splitlines()
+              if json.loads(l)["event"] == "epoch"]
+    assert len(epochs) == 1 and epochs[0]["samples_per_sec"] > 0
+
+    t2 = dk.EnsembleTrainer(make_model(), "sgd", num_ensembles=8,
+                            **COMMON, seed=3, checkpoint_dir=cdir)
+    models = t2.train(ds, resume=True)
+    assert len(models) == 8
+    # resumed run only trained the remaining epochs
+    assert len(t2.get_history()) == COMMON["num_epoch"] - 1

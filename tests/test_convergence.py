@@ -1,8 +1,17 @@
 """Convergence workflow — the reference's ``examples/workflow.ipynb`` as a
 test (SURVEY.md §4 item 3): every trainer on MNIST, each must reach a
 threshold accuracy; the distributed ones are compared against the
-SingleTrainer anchor.  Run explicitly: ``pytest -m convergence``.
+SingleTrainer anchor.
+
+A FAST subset (SingleTrainer anchor + sync ADAG + async DOWNPOUR, ~20s)
+runs in the DEFAULT suite so the convergence gate actually fires on every
+test run; the full matrix keeps the ``convergence`` marker (``pytest -m
+convergence``).  Set ``RECORD_CONVERGENCE=path.md`` to write the measured
+accuracy table as a round artifact.
 """
+
+import os
+import time
 
 import numpy as np
 import pytest
@@ -10,14 +19,46 @@ import pytest
 import distkeras_tpu as dk
 from distkeras_tpu.data.transformers import OneHotTransformer
 
-pytestmark = pytest.mark.convergence
+slow = pytest.mark.convergence
 
 N_TRAIN = 8192
+
+_RESULTS: list = []  # (trainer label, accuracy, seconds)
+
+
+def record(name, acc, seconds):
+    _RESULTS.append((name, float(acc), float(seconds)))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _write_artifact():
+    yield
+    path = os.environ.get("RECORD_CONVERGENCE")
+    if not path or not _RESULTS:
+        return
+    with open(path, "w") as f:
+        f.write("# CONVERGENCE — measured trainer accuracies\n\n")
+        f.write(f"MNIST ({N_TRAIN} train samples), mlp_mnist(hidden=128), "
+                "8 fake CPU devices, recorded by tests/test_convergence.py "
+                f"on {time.strftime('%Y-%m-%d')}.\n")
+        if _META.get("synthetic"):
+            f.write("Dataset: deterministic synthetic MNIST surrogate "
+                    "(air-gapped environment, data/datasets.py fallback) — "
+                    "easier than real MNIST; the gate checks relative "
+                    "convergence, anchored to SingleTrainer.\n")
+        f.write("\n")
+        f.write("| trainer | accuracy | train time (s) |\n|---|---|---|\n")
+        for name, acc, sec in _RESULTS:
+            f.write(f"| {name} | {acc:.4f} | {sec:.1f} |\n")
+
+
+_META: dict = {}
 
 
 @pytest.fixture(scope="module")
 def mnist():
     train, test, meta = dk.datasets.load_mnist(n_train=N_TRAIN)
+    _META.update(meta)
     enc = OneHotTransformer(10, "label", "label_onehot")
     return enc.transform(train), enc.transform(test.take(2048))
 
@@ -38,38 +79,55 @@ def anchor_acc(mnist):
     t = dk.SingleTrainer(dk.zoo.mlp_mnist(hidden=128), "sgd", **COMMON)
     m = t.train(train)
     acc = accuracy(m, test)
-    assert acc > 0.9, f"SingleTrainer anchor failed to converge: {acc}"
+    record("SingleTrainer (anchor)", acc, t.get_training_time())
     return acc
+
+
+def test_mnist_anchor_converges(anchor_acc):
+    """Default-suite convergence gate: the MNIST anchor must converge."""
+    assert anchor_acc > 0.9, f"SingleTrainer anchor failed: {anchor_acc}"
 
 
 # DOWNPOUR/DynSGD sum worker deltas (reference PS semantics: every commit
 # applied in full), so the stable step scales as ~1/(workers×window): they
 # need a small window and lr, exactly as the upstream README warns (its
-# stated reason to prefer ADAG).
+# stated reason to prefer ADAG).  ADAG is unmarked: it is the flagship
+# algorithm and the default-suite gate.
 @pytest.mark.parametrize("cls,kw", [
     (dk.ADAG, dict(communication_window=8)),
-    (dk.DOWNPOUR, dict(communication_window=2, learning_rate=0.01)),
-    (dk.DynSGD, dict(communication_window=2, learning_rate=0.01)),
-    (dk.AEASGD, dict(communication_window=8, rho=1.0)),
-    (dk.EAMSGD, dict(communication_window=8, rho=1.0, momentum=0.9)),
+    pytest.param(dk.DOWNPOUR,
+                 dict(communication_window=2, learning_rate=0.01),
+                 marks=slow),
+    pytest.param(dk.DynSGD,
+                 dict(communication_window=2, learning_rate=0.01),
+                 marks=slow),
+    pytest.param(dk.AEASGD, dict(communication_window=8, rho=1.0),
+                 marks=slow),
+    pytest.param(dk.EAMSGD,
+                 dict(communication_window=8, rho=1.0, momentum=0.9),
+                 marks=slow),
 ])
 def test_sync_trainers_near_anchor(mnist, anchor_acc, cls, kw):
     train, test = mnist
     t = cls(dk.zoo.mlp_mnist(hidden=128), "sgd", num_workers=8,
             **{**COMMON, **kw})
     acc = accuracy(t.train(train), test)
+    record(f"{cls.__name__} (sync)", acc, t.get_training_time())
     # distributed async algorithms trade a little accuracy for parallelism;
     # within 15 points of the anchor and clearly learned
     assert acc > max(0.65, anchor_acc - 0.15), (acc, anchor_acc)
 
 
+# async DOWNPOUR is unmarked: the default suite exercises a real localhost
+# parameter server end-to-end
 @pytest.mark.parametrize("cls,kw", [
     (dk.DOWNPOUR, dict(communication_window=8)),
-    (dk.DynSGD, dict(communication_window=8)),
+    pytest.param(dk.DynSGD, dict(communication_window=8), marks=slow),
 ])
 def test_async_trainers_converge(mnist, anchor_acc, cls, kw):
     train, test = mnist
     t = cls(dk.zoo.mlp_mnist(hidden=128), "sgd", num_workers=4,
             mode="async", **COMMON, **kw)
     acc = accuracy(t.train(train), test)
+    record(f"{cls.__name__} (async)", acc, t.get_training_time())
     assert acc > max(0.6, anchor_acc - 0.2), (acc, anchor_acc)

@@ -48,3 +48,45 @@ def test_job_local_roundtrip(tmp_path):
     acc = dk.AccuracyEvaluator("prediction", "label").evaluate(pred)
     assert acc > 0.6
     assert job.result_history is not None and len(job.result_history) == 5
+
+
+def test_job_runner_rebuilds_keras_adapter(tmp_path):
+    """A packaged KerasAdapter job must rebuild through serde's dispatch
+    (job_runner used Model.from_config only and crashed on Keras configs)."""
+    keras = pytest.importorskip("keras")
+    if keras.backend.backend() != "jax":
+        pytest.skip("keras not on the JAX backend")
+    from distkeras_tpu import job_runner
+    from distkeras_tpu.models.keras_adapter import KerasAdapter
+    from distkeras_tpu.utils import serde
+
+    ds = toy_problem(n=256)
+    npz = str(tmp_path / "data.npz")
+    np.savez(npz, features=ds["features"], label=ds["label"],
+             label_onehot=ds["label_onehot"])
+    model = KerasAdapter(keras.Sequential([
+        keras.layers.Input((10,)),
+        keras.layers.Dense(16, activation="relu"),
+        keras.layers.Dense(3, activation="softmax"),
+    ]))
+    job = Job(
+        "keras-job", model,
+        trainer_spec={"class": "SingleTrainer",
+                      "kwargs": {"worker_optimizer": "sgd",
+                                 "loss": "categorical_crossentropy",
+                                 "features_col": "features",
+                                 "label_col": "label_onehot",
+                                 "num_epoch": 2, "batch_size": 32,
+                                 "learning_rate": 0.05}},
+        dataset_spec={"npz": npz},
+    )
+    pkg = str(tmp_path / "k.job")
+    out = str(tmp_path / "k.result")
+    with open(pkg, "wb") as f:
+        f.write(job.package())
+    job_runner.run_package(pkg, out)  # in-process: this crashed pre-fix
+    with open(out, "rb") as f:
+        payload = serde.tree_from_bytes(f.read())
+    trained, variables = serde.deserialize_model(payload["model"])
+    assert isinstance(trained, KerasAdapter)
+    assert variables is not None

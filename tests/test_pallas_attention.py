@@ -60,3 +60,30 @@ def test_mha_flash_impl():
     yf, _ = m_flash.apply(v, x)
     np.testing.assert_allclose(np.asarray(yf), np.asarray(yd),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_flash_awkward_length_causal_pads_exactly():
+    """Prime T has no block divisor; the causal path must transparently pad
+    to a 128 multiple (exact: padded keys are never attended) instead of
+    silently running a degenerate block=1 grid."""
+    from distkeras_tpu.ops.attention import _flash_with_blocking
+    q, k, v = qkv(b=1, t=257, h=2, dh=16, seed=1)
+    dense = dot_product_attention(q, k, v, causal=True)
+    flash = _flash_with_blocking(q, k, v, True, 257)
+    assert flash.shape == dense.shape
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
+                               rtol=2e-5, atol=2e-5)
+    # gradients stay exact through the pad+slice
+    gf = jax.grad(lambda a: jnp.sum(
+        _flash_with_blocking(a, k, v, True, 257) ** 2))(q)
+    gd = jax.grad(lambda a: jnp.sum(
+        dot_product_attention(a, k, v, causal=True) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                               rtol=5e-4, atol=1e-5)
+
+
+def test_flash_awkward_length_noncausal_raises():
+    from distkeras_tpu.ops.attention import _flash_with_blocking
+    q, k, v = qkv(b=1, t=257, h=2, dh=16)
+    with pytest.raises(ValueError, match="block-sized divisor"):
+        _flash_with_blocking(q, k, v, False, 257)

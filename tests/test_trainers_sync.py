@@ -51,16 +51,21 @@ def anchor_acc(ds):
     compares all trainers against the single-worker result)."""
     t = dk.SingleTrainer(make_model(), "sgd", **COMMON)
     m = t.train(ds)
-    acc = accuracy(m, ds)
-    assert acc > 0.9
-    assert t.get_training_time() > 0
-    assert len(t.get_history()) == COMMON["num_epoch"]
-    assert t.get_averaged_history()[-1] < t.get_averaged_history()[0]
-    return acc
+    # no asserts here: a degraded anchor must FAIL test_single_trainer_anchor,
+    # not ERROR every dependent test (ADVICE r2)
+    _anchor_trainer["t"] = t
+    return accuracy(m, ds)
+
+
+_anchor_trainer: dict = {}
 
 
 def test_single_trainer_anchor(anchor_acc):
     assert anchor_acc > 0.9
+    t = _anchor_trainer["t"]
+    assert t.get_training_time() > 0
+    assert len(t.get_history()) == COMMON["num_epoch"]
+    assert t.get_averaged_history()[-1] < t.get_averaged_history()[0]
 
 
 # (cls, kwargs, extra epochs over COMMON, allowed accuracy gap vs anchor).
@@ -91,7 +96,8 @@ def test_bf16_compute_dtype_converges(ds, anchor_acc):
     t = dk.SingleTrainer(make_model(), "sgd", compute_dtype="bfloat16",
                          **COMMON)
     acc = accuracy(t.train(ds), ds)
-    assert abs(acc - anchor_acc) < 0.03
+    # one-sided: doing BETTER than the f32 anchor is not a failure (ADVICE r2)
+    assert acc > anchor_acc - 0.03
 
     d = dk.ADAG(make_model(), "sgd", num_workers=8, communication_window=4,
                 compute_dtype="bfloat16", **dict(COMMON, num_epoch=12))
@@ -186,3 +192,16 @@ def test_comm_rule_math():
     E = a * (np.asarray(local) - np.asarray(center))
     np.testing.assert_allclose(l2, np.asarray(local) - E, rtol=1e-6)
     np.testing.assert_allclose(c2, np.asarray(center) + E.sum(0), rtol=1e-6)
+
+
+def test_hyperparam_mutation_between_train_calls(ds):
+    """The cached compiled programs must rebuild when a hyperparameter
+    changes (review: cache had no invalidation path)."""
+    t = dk.SingleTrainer(make_model(), "sgd", **COMMON)
+    t.train(ds)
+    assert t.get_averaged_history()[-1] < t.get_averaged_history()[0]
+    t.history.clear()
+    t.learning_rate = 0.0  # must take effect: loss cannot move
+    t.train(ds)
+    h = t.get_averaged_history()
+    np.testing.assert_allclose(h[0], h[-1], rtol=1e-6)
