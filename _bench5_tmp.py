@@ -1,0 +1,20 @@
+import time, numpy as np, sys
+sys.path.insert(0, "/root/repo")
+import distkeras_tpu as dk
+from distkeras_tpu.data.streaming import ShardedFileDataset
+from distkeras_tpu.data.transformers import OneHotTransformer
+import tempfile, os
+
+# streaming ResNet-50: imagenet-subset from DISK shards
+tr, te, _ = dk.datasets.load_imagenet_subset(n_train=1024, num_classes=100, image_size=96)
+tr = OneHotTransformer(100, "label", "label_onehot").transform(tr)
+td = tempfile.mkdtemp()
+src = ShardedFileDataset.write(tr, td, rows_per_shard=256)
+t = dk.SingleTrainer(dk.zoo.resnet50(num_classes=100, input_size=96), "sgd",
+                     features_col="features", label_col="label_onehot",
+                     num_epoch=3, batch_size=16, learning_rate=0.005,
+                     compute_dtype="bfloat16")
+t.train(src)
+eps = [r for r in t.metrics.records if r["event"] == "epoch"]
+print("STREAM resnet50/96px from disk, per-epoch samples/sec:",
+      [round(r["samples_per_sec"]) for r in eps])

@@ -1,0 +1,191 @@
+"""Config layer — dataclass + YAML/CLI (SURVEY.md §5.6 "TPU equivalent").
+
+The reference configures everything through trainer constructor kwargs
+(``distkeras/trainers.py`` — no config files, no flags); that stays our
+API.  This module is the one layer on top the survey prescribes for the
+benchmark harness: a ``RunConfig`` dataclass, YAML loading, and a CLI so a
+single checked-in file reproduces a whole benchmark table
+(``configs/bench_all.yaml`` ↔ ``scripts/bench_all.py``) or packages the
+same run as a deployable ``Job``.
+
+YAML shape (one mapping per run; a top-level ``configs:`` list holds
+several)::
+
+    name: ADAG ConvNet/CIFAR-10
+    trainer: ADAG                    # class in distkeras_tpu.trainers
+    model: convnet_cifar10           # factory in distkeras_tpu.models.zoo
+    model_kwargs: {num_classes: 10}
+    dataset: load_cifar10            # loader in distkeras_tpu.data.datasets
+    dataset_kwargs: {n_train: 8192}
+    onehot: 10                       # one-hot "label" -> "label_onehot"
+    test_take: 1024                  # null -> skip accuracy eval
+    trainer_kwargs: {num_workers: 8, batch_size: 64, num_epoch: 5}
+    quick: {dataset_kwargs: {n_train: 2048}, trainer_kwargs: {num_epoch: 2}}
+
+``python -m distkeras_tpu.config FILE [--quick] [--job OUT.job]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+_DEFAULT_TRAINER_KW = dict(loss="categorical_crossentropy",
+                           features_col="features",
+                           label_col="label_onehot")
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """One benchmark/training run, fully reproducible from data."""
+
+    name: str
+    trainer: str = "SingleTrainer"
+    model: str = "mlp_mnist"
+    model_kwargs: dict = dataclasses.field(default_factory=dict)
+    dataset: str = "load_mnist"
+    dataset_kwargs: dict = dataclasses.field(default_factory=dict)
+    onehot: Optional[int] = 10
+    test_take: Optional[int] = 1024
+    trainer_kwargs: dict = dataclasses.field(default_factory=dict)
+    quick: dict = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RunConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown RunConfig keys {sorted(unknown)} "
+                             f"(known: {sorted(known)})")
+        return cls(**d)
+
+    def with_quick(self) -> "RunConfig":
+        """Apply the config's ``quick`` overrides (smaller data / fewer
+        epochs for smoke runs); dict fields merge, scalars replace."""
+        if not self.quick:
+            return self
+        d = dataclasses.asdict(self)
+        q = d.pop("quick")
+        for k, v in q.items():
+            if isinstance(v, dict) and isinstance(d.get(k), dict):
+                d[k] = {**d[k], **v}
+            else:
+                d[k] = v
+        return RunConfig(**d, quick={})
+
+
+def load_file(path: str) -> list:
+    """YAML file -> list of RunConfig (single mapping or ``configs:`` list)."""
+    import yaml
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    entries = doc["configs"] if isinstance(doc, dict) and "configs" in doc \
+        else [doc]
+    return [RunConfig.from_dict(e) for e in entries]
+
+
+def build(cfg: RunConfig):
+    """RunConfig -> (trainer, train_dataset, test_dataset_or_None)."""
+    import distkeras_tpu as dk
+    from .data.transformers import OneHotTransformer
+
+    model = getattr(dk.zoo, cfg.model)(**cfg.model_kwargs)
+    train, test, _meta = getattr(dk.datasets, cfg.dataset)(
+        **cfg.dataset_kwargs)
+    if cfg.onehot:
+        enc = OneHotTransformer(int(cfg.onehot), "label", "label_onehot")
+        train = enc.transform(train)
+        test = enc.transform(test)
+    test = test.take(int(cfg.test_take)) if cfg.test_take else None
+
+    kw = {**_DEFAULT_TRAINER_KW, **cfg.trainer_kwargs}
+    if kw.get("num_workers") == "auto":
+        # as many workers as the machine has devices, capped at 8 (the
+        # reference examples' worker count) — lets one YAML run on a
+        # single chip and on an 8-device mesh alike
+        import jax
+        kw["num_workers"] = min(8, len(jax.devices()))
+    trainer_cls = getattr(dk, cfg.trainer)
+    return trainer_cls(model, **kw), train, test
+
+
+def run(cfg: RunConfig) -> dict:
+    """Build + train + evaluate; returns the measured row as a dict."""
+    import distkeras_tpu as dk
+
+    trainer, train, test = build(cfg)
+    t0 = time.time()
+    model = trainer.train(train)
+    if isinstance(model, list):  # EnsembleTrainer
+        model = model[0]
+    wall = time.time() - t0
+    epochs = [r for r in trainer.metrics.records if r["event"] == "epoch"]
+    if len(epochs) > 1:
+        sps, note = epochs[-1]["samples_per_sec"], "last epoch"
+    else:
+        samples = sum(np.size(h) for h in trainer.get_history()) \
+            * trainer.batch_size
+        sps, note = samples / wall, "incl. compile"
+    acc = None
+    if test is not None:
+        pred = dk.ModelPredictor(model, "features").predict(test)
+        acc = dk.AccuracyEvaluator("prediction", "label").evaluate(pred)
+    return {"name": cfg.name, "samples_per_sec": sps, "note": note,
+            "accuracy": acc, "wall_seconds": wall}
+
+
+def to_job(cfg: RunConfig, punchcard=None):
+    """RunConfig -> deployable ``job_deployment.Job`` (same spec)."""
+    from .job_deployment import Job
+    import distkeras_tpu as dk
+
+    model = getattr(dk.zoo, cfg.model)(**cfg.model_kwargs)
+    kw = {**_DEFAULT_TRAINER_KW, **cfg.trainer_kwargs}
+    return Job(cfg.name.replace(" ", "-").replace("/", "-"), model,
+               trainer_spec={"class": cfg.trainer, "kwargs": kw},
+               dataset_spec={"loader": cfg.dataset,
+                             "kwargs": cfg.dataset_kwargs},
+               punchcard=punchcard)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="run every config in a YAML file, print a table")
+    ap.add_argument("file")
+    ap.add_argument("--quick", action="store_true",
+                    help="apply each config's quick: overrides")
+    ap.add_argument("--job", metavar="OUT",
+                    help="package the (single) config as a Job file "
+                         "instead of running it")
+    args = ap.parse_args(argv)
+
+    cfgs = load_file(args.file)
+    if args.quick:
+        cfgs = [c.with_quick() for c in cfgs]
+    if args.job:
+        if len(cfgs) != 1:
+            print("--job needs a file with exactly one config",
+                  file=sys.stderr)
+            return 2
+        with open(args.job, "wb") as f:
+            f.write(to_job(cfgs[0]).package())
+        print(f"wrote job package {args.job}")
+        return 0
+
+    print("| config | samples/sec/chip | accuracy | wall |")
+    print("|---|---|---|---|")
+    for cfg in cfgs:
+        row = run(cfg)
+        acc = f"{row['accuracy']:.3f}" if row["accuracy"] is not None else "—"
+        print(f"| {row['name']} | {row['samples_per_sec']:,.0f} "
+              f"({row['note']}) | {acc} | {row['wall_seconds']:.1f}s |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

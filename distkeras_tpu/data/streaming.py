@@ -1,0 +1,159 @@
+"""Disk-backed streaming input — SURVEY.md §7 hard part 6.
+
+The in-memory ``Dataset`` holds every column as one ndarray — fine for
+MNIST/CIFAR, wrong for ImageNet-scale inputs (BASELINE config 5).  This
+module streams batches from a directory of ``.npz`` shards with bounded
+host memory: at any moment only the current shard plus a small prefetch
+queue is resident.
+
+Two pipeline engines, same iterator contract:
+
+* ``"tfdata"`` — ``tf.data`` (installed in this image): shard files →
+  ``from_generator`` → ``prefetch(AUTOTUNE)``; the background threading,
+  autotuning and fusion come from tf.data's runtime.
+* ``"thread"`` — dependency-free fallback: a producer thread reads shards
+  and slices batches into a bounded ``queue.Queue`` so disk IO overlaps
+  device compute.
+
+``SingleTrainer.train`` accepts a ``ShardedFileDataset`` directly: epochs
+stream window-by-window from disk while the TPU trains the previous
+window (the trainer never materializes an epoch in RAM).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+_META = "meta.json"
+
+
+class ShardedFileDataset:
+    """A directory of row-aligned ``.npz`` shards + a ``meta.json``.
+
+    Create one with :meth:`write` (from any in-memory ``Dataset``) or point
+    it at an existing directory produced by another writer (each shard: one
+    ``.npz`` with identical keys; meta lists shards in order).
+    """
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        with open(os.path.join(directory, _META)) as f:
+            meta = json.load(f)
+        self.shards: list = meta["shards"]
+        self.num_rows: int = int(meta["num_rows"])
+        self.column_names: list = meta["columns"]
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def write(dataset, directory: str,
+              rows_per_shard: int = 4096) -> "ShardedFileDataset":
+        """Spill an in-memory ``Dataset`` to disk shards."""
+        os.makedirs(directory, exist_ok=True)
+        shards = []
+        for i, lo in enumerate(range(0, dataset.num_rows, rows_per_shard)):
+            hi = min(lo + rows_per_shard, dataset.num_rows)
+            name = f"shard_{i:05d}.npz"
+            np.savez(os.path.join(directory, name),
+                     **{c: dataset[c][lo:hi] for c in dataset.column_names})
+            shards.append(name)
+        with open(os.path.join(directory, _META), "w") as f:
+            json.dump({"shards": shards, "num_rows": dataset.num_rows,
+                       "columns": dataset.column_names}, f)
+        return ShardedFileDataset(directory)
+
+    # -- iteration ----------------------------------------------------------
+    def steps_per_epoch(self, batch_size: int) -> int:
+        return self.num_rows // batch_size
+
+    def _load(self, name: str) -> dict:
+        with np.load(os.path.join(self.directory, name)) as d:
+            return {k: d[k] for k in d.files}
+
+    def _batch_source(self, cols: Sequence[str], batch_size: int,
+                      seed: Optional[int]) -> Iterator[tuple]:
+        """Sequential batch generator: shard order (optionally shuffled per
+        epoch), rows carried across shard boundaries, remainder dropped
+        (static shapes — SURVEY.md §7 XLA recompilation trap)."""
+        order = list(range(len(self.shards)))
+        if seed is not None:
+            np.random.default_rng(seed).shuffle(order)
+        carry = None
+        for si in order:
+            shard = self._load(self.shards[si])
+            if seed is not None:
+                perm = np.random.default_rng((seed, si)).permutation(
+                    len(shard[cols[0]]))
+                shard = {k: v[perm] for k, v in shard.items()}
+            arrs = [shard[c] for c in cols]
+            if carry is not None:
+                arrs = [np.concatenate([c, a]) for c, a in zip(carry, arrs)]
+            n = arrs[0].shape[0]
+            nb = n // batch_size
+            for b in range(nb):
+                yield tuple(a[b * batch_size:(b + 1) * batch_size]
+                            for a in arrs)
+            rem = n - nb * batch_size
+            carry = [a[n - rem:] for a in arrs] if rem else None
+
+    def batches(self, cols: Sequence[str], batch_size: int,
+                engine: str = "auto", prefetch: int = 4,
+                seed: Optional[int] = None) -> Iterator[tuple]:
+        """Stream ``(col_0, col_1, ...)`` batch tuples from disk."""
+        if engine == "auto":
+            engine = "tfdata" if _has_tf() else "thread"
+        if engine == "tfdata":
+            return self._tfdata_batches(cols, batch_size, prefetch, seed)
+        if engine == "thread":
+            return _prefetched(self._batch_source(cols, batch_size, seed),
+                               prefetch)
+        raise ValueError(f"engine must be auto|tfdata|thread, got {engine!r}")
+
+    def _tfdata_batches(self, cols, batch_size, prefetch, seed):
+        import tensorflow as tf
+        gen = lambda: self._batch_source(cols, batch_size, seed)  # noqa: E731
+        probe = next(self._batch_source(cols, batch_size, seed))
+        spec = tuple(tf.TensorSpec((batch_size, *a.shape[1:]), a.dtype)
+                     for a in probe)
+        ds = tf.data.Dataset.from_generator(gen, output_signature=spec)
+        ds = ds.prefetch(tf.data.AUTOTUNE)
+        return ((tuple(t.numpy() for t in item)) for item in ds)
+
+
+def _has_tf() -> bool:
+    try:
+        import tensorflow  # noqa: F401
+        return True
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def _prefetched(it: Iterator, depth: int) -> Iterator:
+    """Run ``it`` in a producer thread with a bounded queue: disk reads
+    overlap consumer (device) work; memory stays bounded at ``depth``
+    batches."""
+    q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+    _END = object()
+
+    def produce():
+        try:
+            for item in it:
+                q.put(item)
+            q.put(_END)
+        except BaseException as e:  # surfaced on the consumer side
+            q.put(e)
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            return
+        if isinstance(item, BaseException):
+            raise item
+        yield item

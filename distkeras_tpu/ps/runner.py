@@ -1,19 +1,33 @@
 """Async-mode training driver — the reference's ``DistributedTrainer.train``
 orchestration (start PS → ship workers → join → collect center), minus
-Spark: workers are threads with their own devices, data slices come from
-the partitioned ``Dataset``, and the PS lives on localhost TCP (the same
-star topology; multi-host placement via ``jax.distributed`` puts the PS on
-process 0 and workers elsewhere with identical code).
+Spark: the PS lives on localhost TCP (the same star topology), data slices
+come from the partitioned ``Dataset``, and workers run as either
+
+* **threads** (default): in-process, one device each — JAX compute releases
+  the GIL so windows genuinely overlap; fast and hermetic, or
+* **processes** (``async_workers="processes"``): one OS process per worker
+  (``ps.worker_main``), the reference's actual deployment shape (Spark
+  executor tasks, SURVEY.md §3.1 boundary #1) — full process isolation,
+  commits arrive over real TCP from real processes.  On a multi-host pod
+  the same spec files point workers at the coordinator's address
+  (``parallel.multihost``); on this machine they run on CPU by default so
+  they never fight the parent for the single TPU chip
+  (``DKTPU_WORKER_PLATFORM`` overrides).
 """
 
 from __future__ import annotations
 
+import os
+import subprocess
+import sys
+import tempfile
 from typing import Optional
 
 import jax
 import numpy as np
 
 from ..parallel.sync import make_window_fn
+from ..utils import serde
 from .servers import SocketParameterServer
 from .workers import ElasticWorker, PullCommitWorker, StalenessWorker
 
@@ -28,14 +42,12 @@ def run_async_training(trainer, dataset, fault_injector=None):
     """Drive async-PS training for a DistributedTrainer subclass.
 
     The trainer supplies: model/loss/optimizer, ``num_workers``,
-    ``communication_window``, epochs, the PS class (``_ps_factory``) and
-    the worker flavor (``_async_mode`` attribute).
+    ``communication_window``, epochs, the PS class (``_ps_factory``), the
+    worker flavor (``_async_mode``) and the worker placement
+    (``async_workers``: threads or processes).
     """
-    loss_fn, optimizer = trainer._resolve()
-    window_fn = make_window_fn(trainer.model, loss_fn, optimizer,
-                               compute_dtype=trainer.compute_dtype)
     mode = getattr(trainer, "_async_mode", "pull_commit")
-    worker_cls = _WORKER_CLASSES[mode]
+    placement = getattr(trainer, "async_workers", "threads")
 
     xs, ys, _ = trainer._stage_data(dataset, trainer.communication_window)
 
@@ -50,68 +62,183 @@ def run_async_training(trainer, dataset, fault_injector=None):
     ps = trainer._ps_factory()(center, num_workers=trainer.num_workers,
                                **ps_kwargs)
     num_epoch = trainer.num_epoch
+    start_windows = [0] * trainer.num_workers
     if ckpt is not None and getattr(trainer, "_resume", False):
         if ps.restore(ckpt):
-            # true async training has no global epoch barrier; approximate
-            # completed epochs from the commit counter (workers × windows
-            # commits per epoch) and train only the remainder
-            commits_per_epoch = trainer.num_workers * xs.shape[1]
-            done = ps.num_updates // max(1, commits_per_epoch)
-            num_epoch = max(0, trainer.num_epoch - done)
+            # EXACT resume: one commit per communication window, so the
+            # snapshot's per-worker commit count IS the global window index
+            # each worker continues from — mid-epoch included (SURVEY.md
+            # §5.4).  No epoch approximation from the global counter.
+            start_windows = [ps.commits_by_worker.get(k, 0)
+                             for k in range(trainer.num_workers)]
             center = ps.get_model()  # workers start from the restored center
     server = SocketParameterServer(ps, fault_injector=fault_injector).start()
 
-    devices = jax.devices()
-    workers = []
     try:
-        for k in range(trainer.num_workers):
-            dev = devices[k % len(devices)]
-            kw = {}
-            if worker_cls is ElasticWorker:
-                kw["alpha"] = trainer.alpha
-            variables = jax.device_put(center, dev)
-            opt_state = jax.device_put(optimizer.init(center["params"]), dev)
-            rng = jax.device_put(
-                jax.random.PRNGKey(trainer.seed + 1 + k), dev)
-            w = worker_cls(k, window_fn, variables, opt_state, rng,
-                           "127.0.0.1", server.port, num_epoch,
-                           device=dev, **kw)
-            w.set_data(xs[k], ys[k])
-            workers.append(w)
-        for w in workers:
-            w.start()
-        for w in workers:
-            w.join()
-        # failed-task retry, the reference's implicit Spark behavior
-        # (SURVEY.md §3.1: a failed executor task is rescheduled and its
-        # partition silently re-trained): re-run each failed worker ONCE
-        # from the current center; a second failure is fatal.
-        for i, w in enumerate(workers):
-            if w.error is None:
-                continue
-            fresh_center = ps.get_model()
-            kw = {"alpha": trainer.alpha} if worker_cls is ElasticWorker else {}
-            dev = w.device
-            retry = worker_cls(
-                w.worker_id, window_fn,
-                jax.device_put(fresh_center, dev),
-                jax.device_put(optimizer.init(fresh_center["params"]), dev),
-                jax.device_put(jax.random.PRNGKey(
-                    trainer.seed + 101 + w.worker_id), dev),
-                "127.0.0.1", server.port, num_epoch, device=dev, **kw)
-            retry.set_data(xs[w.worker_id], ys[w.worker_id])
-            retry.start()
-            retry.join()
-            if retry.error is not None:
-                raise RuntimeError(
-                    f"async worker {w.worker_id} failed twice"
-                ) from retry.error
-            workers[i] = retry
+        if placement == "processes":
+            losses = _run_process_workers(trainer, ps, server, mode, center,
+                                          xs, ys, num_epoch, start_windows)
+        else:
+            losses = _run_thread_workers(trainer, ps, server, mode, center,
+                                         xs, ys, num_epoch, start_windows)
     finally:
         server.stop()
 
-    # history: list per epoch of (workers, steps)
-    for e in range(num_epoch):
-        trainer.history.append(np.stack(
-            [w.losses[e].reshape(-1) for w in workers]))
+    # history: one row per epoch this run touched — (workers, steps) when
+    # every worker trained that full epoch (the aligned fresh-run case),
+    # else the available per-worker arrays (resumed runs may start
+    # mid-epoch at per-worker offsets)
+    for e in sorted(set().union(*[set(l) for l in losses])):
+        rows = [l[e].reshape(-1) for l in losses if e in l]
+        trainer.history.append(
+            np.stack(rows) if len(rows) == trainer.num_workers else rows)
+    trainer.ps_stats = {"num_updates": ps.num_updates,
+                        "commits_by_worker": dict(ps.commits_by_worker),
+                        "staleness_seen": list(getattr(ps, "staleness_seen",
+                                                       []))}
     return trainer._finish(ps.get_model())
+
+
+# ---------------------------------------------------------------------------
+# thread placement (in-process, one device per worker)
+# ---------------------------------------------------------------------------
+
+def _run_thread_workers(trainer, ps, server, mode, center, xs, ys, num_epoch,
+                        start_windows):
+    loss_fn, optimizer = trainer._resolve()
+    window_fn = make_window_fn(trainer.model, loss_fn, optimizer,
+                               compute_dtype=trainer.compute_dtype)
+    worker_cls = _WORKER_CLASSES[mode]
+    devices = jax.devices()
+    workers = []
+    for k in range(trainer.num_workers):
+        dev = devices[k % len(devices)]
+        kw = {}
+        if worker_cls is ElasticWorker:
+            kw["alpha"] = trainer.alpha
+        variables = jax.device_put(center, dev)
+        opt_state = jax.device_put(optimizer.init(center["params"]), dev)
+        rng = jax.device_put(
+            jax.random.PRNGKey(trainer.seed + 1 + k), dev)
+        w = worker_cls(k, window_fn, variables, opt_state, rng,
+                       "127.0.0.1", server.port, num_epoch,
+                       device=dev, start_window=start_windows[k], **kw)
+        w.set_data(xs[k], ys[k])
+        workers.append(w)
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()
+    # failed-task retry, the reference's implicit Spark behavior
+    # (SURVEY.md §3.1: a failed executor task is rescheduled): re-run each
+    # failed worker ONCE from the current center, continuing from the exact
+    # window its commits reached (the PS's per-worker counter); a second
+    # failure is fatal.
+    merged = [w.epoch_losses for w in workers]
+    for i, w in enumerate(workers):
+        if w.error is None:
+            continue
+        fresh_center = ps.get_model()
+        kw = {"alpha": trainer.alpha} if worker_cls is ElasticWorker else {}
+        dev = w.device
+        retry = worker_cls(
+            w.worker_id, window_fn,
+            jax.device_put(fresh_center, dev),
+            jax.device_put(optimizer.init(fresh_center["params"]), dev),
+            jax.device_put(jax.random.PRNGKey(
+                trainer.seed + 101 + w.worker_id), dev),
+            "127.0.0.1", server.port, num_epoch, device=dev,
+            start_window=ps.commits_by_worker.get(w.worker_id, 0), **kw)
+        retry.set_data(xs[w.worker_id], ys[w.worker_id])
+        retry.start()
+        retry.join()
+        if retry.error is not None:
+            raise RuntimeError(
+                f"async worker {w.worker_id} failed twice"
+            ) from retry.error
+        merged[i] = {**w.epoch_losses, **retry.epoch_losses}
+    return merged
+
+
+# ---------------------------------------------------------------------------
+# process placement (one OS process per worker — ps.worker_main)
+# ---------------------------------------------------------------------------
+
+def _worker_env() -> dict:
+    env = dict(os.environ)
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    # single-accelerator machines: worker processes must not fight the
+    # parent for the chip; real pods set DKTPU_WORKER_PLATFORM=tpu (one
+    # worker process per host, each owning its local chips)
+    env["JAX_PLATFORMS"] = os.environ.get("DKTPU_WORKER_PLATFORM", "cpu")
+    env.pop("XLA_FLAGS", None)  # don't inherit the test mesh's fake devices
+    return env
+
+
+def _spawn(spec: dict, td: str, k: int, timeout) -> subprocess.Popen:
+    spec_path = os.path.join(td, f"worker_{k}_{spec['attempt']}.spec")
+    with open(spec_path, "wb") as f:
+        f.write(serde.tree_to_bytes(spec))
+    return subprocess.Popen(
+        [sys.executable, "-m", "distkeras_tpu.ps.worker_main", spec_path],
+        env=_worker_env())
+
+
+def _run_process_workers(trainer, ps, server, mode, center, xs, ys,
+                         num_epoch, start_windows, timeout: float = 1800.0):
+    model_blob = serde.serialize_model(trainer.model, center)
+
+    def make_spec(k: int, blob: bytes, seed: int, td: str, attempt: int,
+                  start_window: int):
+        data = os.path.join(td, f"data_{k}.npz")
+        if not os.path.exists(data):
+            np.savez(data, xs=xs[k], ys=ys[k])
+        return {
+            "model_blob": blob,
+            "worker_optimizer": trainer.worker_optimizer
+            if isinstance(trainer.worker_optimizer, str) else "sgd",
+            "loss": trainer.loss,
+            "learning_rate": trainer.learning_rate,
+            "compute_dtype": str(trainer.compute_dtype)
+            if trainer.compute_dtype is not None else None,
+            "mode": mode,
+            "alpha": float(getattr(trainer, "alpha", 0.0)),
+            "worker_id": k, "host": "127.0.0.1", "port": server.port,
+            "num_epoch": num_epoch, "seed": seed,
+            "start_window": int(start_window),
+            "data_npz": data,
+            "out_npz": os.path.join(td, f"out_{k}_{attempt}.npz"),
+            "attempt": attempt,
+        }
+
+    def read_epochs(out_npz: str) -> dict:
+        with np.load(out_npz) as d:
+            return {int(name.split("_", 1)[1]): d[name] for name in d.files}
+
+    with tempfile.TemporaryDirectory() as td:
+        specs = [make_spec(k, model_blob, trainer.seed + 1 + k, td, 0,
+                           start_windows[k])
+                 for k in range(trainer.num_workers)]
+        procs = [_spawn(s, td, k, timeout) for k, s in enumerate(specs)]
+        for p in procs:
+            p.wait(timeout=timeout)
+        losses = []
+        # Spark-style single retry from the current center, continuing at
+        # the exact window the dead process's commits reached (thread path
+        # has the same rule)
+        for k, p in enumerate(procs):
+            if p.returncode == 0:
+                losses.append(read_epochs(specs[k]["out_npz"]))
+                continue
+            fresh = serde.serialize_model(trainer.model, ps.get_model())
+            specs[k] = make_spec(k, fresh, trainer.seed + 101 + k, td, 1,
+                                 ps.commits_by_worker.get(k, 0))
+            retry = _spawn(specs[k], td, k, timeout)
+            retry.wait(timeout=timeout)
+            if retry.returncode != 0:
+                raise RuntimeError(f"async worker process {k} failed twice "
+                                   f"(rc={retry.returncode})")
+            losses.append(read_epochs(specs[k]["out_npz"]))
+    return losses

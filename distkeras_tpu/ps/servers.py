@@ -54,6 +54,11 @@ class ParameterServer:
         self.center = _tree_map(np.asarray, center)
         self.num_workers = int(num_workers)
         self.num_updates = 0
+        #: per-worker commit counts — exact resume bookkeeping: commit k of
+        #: worker w IS window k of worker w (one commit per communication
+        #: window), so a restored snapshot tells each worker exactly which
+        #: window to continue from (SURVEY.md §5.4).
+        self.commits_by_worker: dict = {}
         self.mutex = threading.Lock()
         self.checkpoint_manager = checkpoint_manager
         self.checkpoint_every = int(checkpoint_every)
@@ -67,15 +72,22 @@ class ParameterServer:
         with self.mutex:
             self.apply_commit(delta, meta)
             self.num_updates += 1
+            w = meta.get("worker_id")
+            if w is not None:
+                w = int(w)
+                self.commits_by_worker[w] = self.commits_by_worker.get(w, 0) + 1
             if (self.checkpoint_manager is not None and self.checkpoint_every
                     and self.num_updates % self.checkpoint_every == 0):
                 # capture the reference only; commits replace (never mutate)
                 # the center tree, so serializing outside the lock is safe
                 # and pulls/commits don't stall on the disk write
-                snapshot = (self.center, self.num_updates)
+                snapshot = (self.center, self.num_updates,
+                            dict(self.commits_by_worker))
         if snapshot is not None:
-            center, n = snapshot
-            self.checkpoint_manager.save(n, center, {"num_updates": n})
+            center, n, by_worker = snapshot
+            self.checkpoint_manager.save(
+                n, center, {"num_updates": n,
+                            "commits_by_worker": by_worker})
 
     def restore(self, checkpoint_manager) -> bool:
         """Load the latest center checkpoint; returns True if restored."""
@@ -84,6 +96,9 @@ class ParameterServer:
         with self.mutex:
             self.center, meta = checkpoint_manager.restore(self.center)
             self.num_updates = int(meta.get("num_updates", 0))
+            self.commits_by_worker = {
+                int(k): int(v)
+                for k, v in (meta.get("commits_by_worker") or {}).items()}
         return True
 
     def pull(self) -> tuple:
@@ -118,10 +133,18 @@ class ADAGParameterServer(ParameterServer):
 class DynSGDParameterServer(ParameterServer):
     """Staleness-aware commits (parity: reference ``DynSGDParameterServer``):
     the worker reports the update counter it last pulled at; staleness =
-    current counter − reported; center += delta / (staleness + 1)."""
+    current counter − reported; center += delta / (staleness + 1).
+
+    ``staleness_seen`` records the staleness of every commit (observability
+    the reference lacked; surfaced as ``trainer.ps_stats`` after training)."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.staleness_seen: list = []
 
     def apply_commit(self, delta, meta):
         staleness = max(0, self.num_updates - int(meta.get("last_update", 0)))
+        self.staleness_seen.append(staleness)
         self.center = _tree_fused_add(self.center, delta,
                                       1.0 / (staleness + 1))
 

@@ -1,0 +1,97 @@
+"""OS-process async worker: ``python -m distkeras_tpu.ps.worker_main SPEC``.
+
+The reference's workers are separate OS processes on separate machines
+(Spark executor tasks shipped via ``rdd.mapPartitionsWithIndex`` — SURVEY.md
+§3.1 boundary #1).  This module is that process: it rebuilds the model from
+a spec file, loads its partition, connects to the parameter server over TCP
+(boundary #2) and runs the epochs × windows pull/commit loop, then writes
+its loss history to the output file.
+
+The spec is a msgpack tree (``utils.serde``):
+
+    {"model_blob": <serialize_model bytes>,
+     "worker_optimizer": str, "loss": str, "learning_rate": float,
+     "compute_dtype": str|None, "mode": "pull_commit"|"staleness"|"elastic",
+     "alpha": float, "worker_id": int, "host": str, "port": int,
+     "num_epoch": int, "seed": int, "data_npz": path, "out_npz": path}
+
+Used by ``ps.runner.run_async_training`` when the trainer asks for
+``async_workers="processes"``; also runnable by hand for manual clusters
+(one spec per host, all pointing at the same PS address).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+import numpy as np
+
+# Honor the platform the spawning runner chose for worker processes.  The
+# env var alone is not enough on machines with an interpreter startup hook
+# that re-points JAX_PLATFORMS at the accelerator (e.g. the axon tunnel):
+# jax.config.update before first backend use is the reliable override.
+_plat = os.environ.get("DKTPU_WORKER_PLATFORM") or os.environ.get(
+    "JAX_PLATFORMS")
+if _plat:
+    import jax
+    jax.config.update("jax_platforms", _plat)
+
+
+def run_spec(spec_path: str) -> None:
+    from ..parallel.sync import make_window_fn
+    from ..trainers import Trainer
+    from ..utils import serde
+    from .runner import _WORKER_CLASSES
+
+    with open(spec_path, "rb") as f:
+        spec = serde.tree_from_bytes(f.read())
+
+    model, center = serde.deserialize_model(spec["model_blob"])
+    # borrow the Trainer's loss/optimizer resolution (probs-variant
+    # detection included) so process workers train the same math as threads
+    shim = Trainer(model, spec["worker_optimizer"], spec["loss"],
+                   learning_rate=spec["learning_rate"],
+                   compute_dtype=spec.get("compute_dtype"))
+    loss_fn, optimizer = shim._resolve()
+    window_fn = make_window_fn(model, loss_fn, optimizer,
+                               compute_dtype=shim.compute_dtype)
+
+    with np.load(spec["data_npz"]) as d:
+        xs, ys = d["xs"], d["ys"]
+
+    import jax
+    worker_cls = _WORKER_CLASSES[spec["mode"]]
+    kw = {"alpha": spec["alpha"]} if spec["mode"] == "elastic" else {}
+    worker = worker_cls(
+        int(spec["worker_id"]), window_fn, center,
+        optimizer.init(center["params"]),
+        jax.random.PRNGKey(int(spec["seed"])),
+        spec["host"], int(spec["port"]), int(spec["num_epoch"]),
+        start_window=int(spec.get("start_window", 0)), **kw)
+    worker.set_data(xs, ys)
+    worker.run()  # synchronously in THIS process (it is the worker process)
+    if worker.error is not None:
+        raise worker.error
+
+    np.savez(spec["out_npz"],
+             **{f"epoch_{e}": l for e, l in worker.epoch_losses.items()})
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 1:
+        print("usage: python -m distkeras_tpu.ps.worker_main SPEC",
+              file=sys.stderr)
+        return 2
+    try:
+        run_spec(argv[0])
+    except Exception:
+        traceback.print_exc()
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

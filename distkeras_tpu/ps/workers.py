@@ -50,7 +50,7 @@ class AsyncWorker(threading.Thread):
     def __init__(self, worker_id: int, window_fn: Callable,
                  variables: Tree, opt_state: Tree, rng,
                  host: str, port: int, num_epoch: int,
-                 device=None):
+                 device=None, start_window: int = 0):
         super().__init__(name=f"worker-{worker_id}", daemon=True)
         self.worker_id = worker_id
         self.window_fn = window_fn
@@ -61,7 +61,14 @@ class AsyncWorker(threading.Thread):
         self.ps_port = port
         self.num_epoch = num_epoch
         self.device = device
+        #: exact resume: global window index to continue from (= this
+        #: worker's commit count in the restored PS snapshot; one commit
+        #: per window).  0 on a fresh run.
+        self.start_window = int(start_window)
         self.losses: list = []          # one (n_windows, w) array per epoch
+        self.epoch_losses: dict = {}    # absolute epoch -> (n_windows, w)
+        #: flat (global_window_index, (w,) losses) pairs — the exact record
+        self.window_losses: list = []
         self.error: Optional[BaseException] = None
         self.xs = self.ys = None        # (n_windows, w, batch, ...) numpy
 
@@ -84,14 +91,24 @@ class AsyncWorker(threading.Thread):
             self.error = e
 
     def _train(self, client: PSClient):
-        for _ in range(self.num_epoch):
-            epoch_losses = []
-            for wi in range(self.xs.shape[0]):
-                wx = self._put(self.xs[wi])
-                wy = self._put(self.ys[wi])
-                losses = self._window(client, wx, wy)
-                epoch_losses.append(np.asarray(losses))
-            self.losses.append(np.stack(epoch_losses))
+        n_windows = int(self.xs.shape[0])
+        total = self.num_epoch * n_windows
+        for gw in range(self.start_window, total):
+            wi = gw % n_windows  # window within the epoch
+            wx = self._put(self.xs[wi])
+            wy = self._put(self.ys[wi])
+            losses = self._window(client, wx, wy)
+            self.window_losses.append((gw, np.asarray(losses)))
+        # per-epoch view for the COMPLETE epochs this run covered (a
+        # resumed worker may start mid-epoch; that partial epoch is only
+        # in window_losses)
+        by_epoch: dict = {}
+        for gw, l in self.window_losses:
+            by_epoch.setdefault(gw // n_windows, []).append(l)
+        self.epoch_losses = {e: np.stack(ls) for e, ls in by_epoch.items()
+                             if len(ls) == n_windows}
+        self.losses = [self.epoch_losses[e]
+                       for e in sorted(self.epoch_losses)]
 
     def _run_window(self, wx, wy):
         self.variables, self.opt_state, self.rng, losses = self.window_fn(

@@ -260,9 +260,21 @@ class SingleTrainer(Trainer):
     """Single-worker baseline (reference ``SingleTrainer`` +
     ``SingleTrainerWorker``): the whole dataset on one chip, a jit-compiled
     ``lax.scan`` over minibatches per epoch.  The conformance anchor all
-    distributed trainers are compared against."""
+    distributed trainers are compared against.
+
+    Also accepts a disk-backed ``data.streaming.ShardedFileDataset``:
+    epochs then stream window-by-window from disk (``stream_window``
+    batches per jit call) with bounded host memory — the ImageNet-scale
+    input story (SURVEY.md §7 hard part 6)."""
+
+    #: batches per jit window call on the streaming path (static shape;
+    #: larger = fewer dispatches, more host RAM in flight)
+    stream_window = 8
 
     def _train(self, dataset: Dataset, shuffle: bool) -> Model:
+        from .data.streaming import ShardedFileDataset
+        if isinstance(dataset, ShardedFileDataset):
+            return self._train_stream(dataset, shuffle)
         if shuffle:
             dataset = dataset.shuffle(self.seed)
         run, optimizer = self._window_run()
@@ -292,6 +304,48 @@ class SingleTrainer(Trainer):
         pipe.flush()
         return self._finish(variables)
 
+    def _train_stream(self, source, shuffle: bool) -> Model:
+        """Stream epochs from disk: the host assembles window w+1 (the
+        prefetch thread / tf.data does the IO) while the device trains
+        window w; loss readback is deferred to epoch edges as usual."""
+        run, optimizer = self._window_run()
+        bs = self.batch_size
+        steps = source.steps_per_epoch(bs)
+        if steps == 0:
+            raise ValueError(f"batch_size {bs} exceeds dataset rows "
+                             f"{source.num_rows}")
+        w = max(1, min(int(self.stream_window), steps))
+        n_windows = steps // w
+
+        variables = self.model.init(self.seed)
+        opt_state = optimizer.init(variables["params"])
+        rng = jax.random.PRNGKey(self.seed + 1)
+        ckpt = self._ckpt_manager()
+        (variables, opt_state, rng), start_epoch = self._maybe_restore(
+            ckpt, (variables, opt_state, rng))
+
+        cols = [self.features_col, self.label_col]
+        samples = n_windows * w * bs
+        pipe = _EpochPipeline(self, samples)
+        for epoch in range(start_epoch, self.num_epoch):
+            seed = (self.seed + 1000 + epoch) if shuffle else None
+            it = source.batches(cols, bs, seed=seed)
+            epoch_losses = []
+            for _ in range(n_windows):
+                window = [next(it) for _ in range(w)]
+                wx = np.stack([b[0] for b in window])
+                wy = np.stack([b[1] for b in window])
+                variables, opt_state, rng, losses = run(
+                    variables, opt_state, rng, jnp.asarray(wx),
+                    jnp.asarray(wy))
+                epoch_losses.append(losses)
+            pipe.push(epoch, jnp.concatenate(epoch_losses))
+            if ckpt is not None:
+                ckpt.save(epoch, (variables, opt_state, rng),
+                          {"epoch": epoch})
+        pipe.flush()
+        return self._finish(variables)
+
 
 class DistributedTrainer(Trainer):
     """Base for multi-worker trainers (reference ``DistributedTrainer``):
@@ -308,7 +362,8 @@ class DistributedTrainer(Trainer):
                  num_epoch: int = 1, batch_size: int = 32,
                  communication_window: Optional[int] = None,
                  learning_rate: float = 0.01, seed: int = 0,
-                 mode: str = "sync", mesh=None, **kw):
+                 mode: str = "sync", mesh=None,
+                 async_workers: str = "threads", **kw):
         super().__init__(keras_model, worker_optimizer, loss, features_col,
                          label_col, num_epoch, batch_size, learning_rate, seed,
                          **kw)
@@ -318,8 +373,15 @@ class DistributedTrainer(Trainer):
             else self._default_window)
         if mode not in ("sync", "async"):
             raise ValueError(f"mode must be 'sync' or 'async', got {mode!r}")
+        if async_workers not in ("threads", "processes"):
+            raise ValueError(f"async_workers must be 'threads' or "
+                             f"'processes', got {async_workers!r}")
         self.mode = mode
         self.mesh = mesh
+        #: async-mode worker placement: in-process threads (fast, hermetic)
+        #: or one OS process per worker — the reference's deployment shape
+        #: (Spark executor tasks); see ``ps.runner`` / ``ps.worker_main``.
+        self.async_workers = async_workers
 
     # -- algorithm hooks ----------------------------------------------------
     def _sync_algorithm(self):

@@ -1,131 +1,20 @@
 """Benchmark harness: the five BASELINE.json configs, one table.
 
-Usage: ``python scripts/bench_all.py [--quick]`` (quick = smaller data /
-fewer epochs; the default sizes are still tractable on one chip).  Prints
-a markdown table row per config: samples/sec/chip + end accuracy where the
-config trains to convergence.
+Usage: ``python scripts/bench_all.py [--quick]``.
+
+The configs live as DATA in ``configs/bench_all.yaml`` (SURVEY.md §5.6:
+one checked-in file reproduces the whole table); this script is a thin
+alias for ``python -m distkeras_tpu.config configs/bench_all.yaml``.
 """
 
-import argparse
 import os
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
 
-import jax
-import numpy as np
-
-import distkeras_tpu as dk
-from distkeras_tpu.data.transformers import OneHotTransformer
-from distkeras_tpu.utils.metrics import MetricsLogger
-
-N_DEV = len(jax.devices())
-
-
-class _Capture(MetricsLogger):
-    def __init__(self):
-        super().__init__(None)
-        self.records = []
-
-    def log(self, event, **fields):
-        rec = super().log(event, **fields)
-        self.records.append(rec)
-        return rec
-
-
-def run_config(name, trainer, train, test, label_col="label_onehot"):
-    cap = _Capture()
-    trainer.metrics = cap
-    t0 = time.time()
-    model = trainer.train(train)
-    if isinstance(model, list):
-        model = model[0]
-    dt = time.time() - t0
-    # steady-state rate: last epoch (first epoch pays XLA compilation);
-    # falls back to whole-run rate for 1-epoch configs
-    epochs = [r for r in cap.records if r["event"] == "epoch"]
-    if len(epochs) > 1:
-        sps = epochs[-1]["samples_per_sec"]
-        note = "last epoch"
-    else:
-        samples = sum(h.size for h in trainer.get_history()) * trainer.batch_size
-        sps = samples / dt
-        note = "incl. compile"
-    acc = float("nan")
-    if test is not None:
-        pred = dk.ModelPredictor(model, "features").predict(test)
-        acc = dk.AccuracyEvaluator("prediction", "label").evaluate(pred)
-    print(f"| {name} | {sps:,.0f} ({note}) | {acc:.3f} | {dt:.1f}s |")
-    return sps, acc
-
-
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--quick", action="store_true")
-    args = ap.parse_args()
-    q = args.quick
-
-    print(f"| config | samples/sec/chip | accuracy | wall |")
-    print(f"|---|---|---|---|")
-    enc10 = OneHotTransformer(10, "label", "label_onehot")
-    enc2 = OneHotTransformer(2, "label", "label_onehot")
-    common = dict(loss="categorical_crossentropy", features_col="features",
-                  label_col="label_onehot")
-
-    # 1. SingleTrainer MLP / MNIST
-    tr, te, _ = dk.datasets.load_mnist(n_train=4096 if q else 16384)
-    tr, te = enc10.transform(tr), enc10.transform(te.take(2048))
-    run_config("SingleTrainer MLP/MNIST",
-               dk.SingleTrainer(dk.zoo.mlp_mnist(), "sgd", **common,
-                                num_epoch=2 if q else 5, batch_size=128,
-                                learning_rate=0.05), tr, te)
-
-    # 2. ADAG ConvNet / CIFAR-10
-    tr, te, _ = dk.datasets.load_cifar10(n_train=2048 if q else 8192)
-    tr, te = enc10.transform(tr), enc10.transform(te.take(1024))
-    workers = min(8, N_DEV)
-    run_config(f"ADAG ConvNet/CIFAR-10 ({workers}w)",
-               dk.ADAG(dk.zoo.convnet_cifar10(), "sgd", num_workers=workers,
-                       communication_window=4, **common,
-                       num_epoch=2 if q else 5, batch_size=64,
-                       learning_rate=0.05), tr, te)
-
-    # 3. DOWNPOUR ResNet-20 / CIFAR-10
-    run_config(f"DOWNPOUR ResNet-20/CIFAR-10 ({workers}w)",
-               dk.DOWNPOUR(dk.zoo.resnet20(), "sgd", num_workers=workers,
-                           communication_window=2, **common,
-                           num_epoch=1 if q else 3, batch_size=64,
-                           learning_rate=0.01), tr, te)
-
-    # 4. AEASGD + EAMSGD LSTM / IMDB
-    tr, te, _ = dk.datasets.load_imdb(n_train=1024 if q else 4096,
-                                      seq_len=64 if q else 200,
-                                      vocab_size=4000)
-    tr, te = enc2.transform(tr), enc2.transform(te.take(512))
-    lstm = dk.zoo.lstm_imdb(vocab_size=4000, embed_dim=64, lstm_units=64,
-                            seq_len=64 if q else 200)
-    run_config(f"AEASGD LSTM/IMDB ({workers}w)",
-               dk.AEASGD(lstm, "sgd", num_workers=workers,
-                         communication_window=4, rho=1.0,
-                         loss="binary_crossentropy",
-                         features_col="features", label_col="label",
-                         num_epoch=1 if q else 3, batch_size=32,
-                         learning_rate=0.05), tr, None)
-
-    # 5. DynSGD ResNet-50 / ImageNet-subset (throughput-focused)
-    size = 64 if q else 96
-    tr, te, _ = dk.datasets.load_imagenet_subset(
-        n_train=256 if q else 1024, num_classes=100, image_size=size)
-    enc100 = OneHotTransformer(100, "label", "label_onehot")
-    tr = enc100.transform(tr)
-    run_config(f"DynSGD ResNet-50/{size}px ({workers}w)",
-               dk.DynSGD(dk.zoo.resnet50(num_classes=100, input_size=size),
-                         "sgd", num_workers=workers,
-                         communication_window=2, **common, num_epoch=1,
-                         batch_size=8 if q else 16,
-                         learning_rate=0.005), tr, None)
-
+from distkeras_tpu import config  # noqa: E402
 
 if __name__ == "__main__":
-    main()
+    sys.exit(config.main(
+        [os.path.join(ROOT, "configs", "bench_all.yaml"), *sys.argv[1:]]))

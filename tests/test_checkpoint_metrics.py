@@ -131,3 +131,62 @@ def test_ensemble_checkpoint_resume_and_metrics(ds, tmp_path):
     assert len(models) == 8
     # resumed run only trained the remaining epochs
     assert len(t2.get_history()) == COMMON["num_epoch"] - 1
+
+
+def test_async_exact_resume_mid_training(ds, tmp_path):
+    """Kill-and-resume for async mode: the PS snapshot's per-worker commit
+    counts let each worker continue from the exact window it reached — no
+    epoch approximation from the global counter (SURVEY.md §5.4)."""
+    cdir = str(tmp_path / "ck")
+    kw = dict(COMMON, num_epoch=2)
+    steps = 2048 // 2 // kw["batch_size"]          # per-worker steps/epoch
+    windows_per_epoch = steps // 4
+    t1 = dk.DOWNPOUR(make_model(), "sgd", num_workers=2, mode="async",
+                     communication_window=4, **{**kw, "num_epoch": 1},
+                     checkpoint_dir=cdir, seed=3)
+    t1.train(ds)
+    assert t1.ps_stats["commits_by_worker"] == {0: windows_per_epoch,
+                                                1: windows_per_epoch}
+
+    # resume to the full 2 epochs: each worker must train ONLY the missing
+    # windows (epoch 1), not re-approximate from the global counter
+    t2 = dk.DOWNPOUR(make_model(), "sgd", num_workers=2, mode="async",
+                     communication_window=4, **kw,
+                     checkpoint_dir=cdir, seed=3)
+    m = t2.train(ds, resume=True)
+    by_worker = t2.ps_stats["commits_by_worker"]
+    assert by_worker == {0: 2 * windows_per_epoch, 1: 2 * windows_per_epoch}
+    # exactly one epoch of new history (epoch index 1)
+    assert len(t2.get_history()) == 1
+    pred = dk.ModelPredictor(m, "features").predict(ds)
+    assert dk.AccuracyEvaluator("prediction", "label").evaluate(pred) > 0.6
+
+
+def test_async_resume_uneven_worker_progress(ds, tmp_path):
+    """Workers at DIFFERENT windows in the snapshot resume at their own
+    offsets (mid-epoch): the old global-counter inference could not do
+    this."""
+    from distkeras_tpu.ps.servers import DeltaParameterServer
+
+    cdir = str(tmp_path / "ck")
+    kw = dict(COMMON, num_epoch=1)
+    steps = 2048 // 2 // kw["batch_size"]
+    windows = steps // 4
+    # hand-build a snapshot where worker 0 is 2 windows in, worker 1 is 5 in
+    model = make_model()
+    center = {"params": model.init(3)["params"], "state": model.init(3)["state"]}
+    import jax
+    center = jax.tree_util.tree_map(np.asarray, center)
+    ps = DeltaParameterServer(center, num_workers=2,
+                              checkpoint_manager=CheckpointManager(cdir),
+                              checkpoint_every=1)
+    for wid, n in ((0, 2), (1, 5)):
+        for _ in range(n):
+            ps.handle_commit(jax.tree_util.tree_map(np.zeros_like, center),
+                             {"worker_id": wid})
+    t = dk.DOWNPOUR(make_model(), "sgd", num_workers=2, mode="async",
+                    communication_window=4, **kw,
+                    checkpoint_dir=cdir, seed=3)
+    t.train(ds, resume=True)
+    by_worker = t.ps_stats["commits_by_worker"]
+    assert by_worker == {0: windows, 1: windows}  # both completed the epoch

@@ -1,0 +1,105 @@
+"""Multi-PROCESS distributed training (SURVEY.md §3.1 boundaries #1/#2).
+
+The reference's workers are separate OS processes on separate machines
+(Spark executor tasks).  These tests exercise that deployment shape for
+real: N OS-process workers (``ps.worker_main``) training against the
+``SocketParameterServer`` over localhost TCP, and a 2-process
+``jax.distributed`` bring-up of ``parallel.multihost.initialize``.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from tests.test_trainers_sync import COMMON, accuracy, make_model, toy_problem
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def ds():
+    return toy_problem()
+
+
+def test_process_workers_converge(ds):
+    """DOWNPOUR with one OS process per worker: commits arrive over real
+    TCP from real processes; the result must still converge."""
+    t = dk.DOWNPOUR(make_model(), "sgd", num_workers=2, mode="async",
+                    async_workers="processes", communication_window=4,
+                    **COMMON)
+    m = t.train(ds)
+    acc = accuracy(m, ds)
+    assert acc > 0.7, acc
+    assert len(t.get_history()) == COMMON["num_epoch"]
+    assert t.get_history()[0].shape[0] == 2  # per-worker loss rows
+    # every worker's every window commit reached the server
+    steps = 2048 // 2 // COMMON["batch_size"]
+    commits = 2 * (steps // 4) * COMMON["num_epoch"]
+    assert t.ps_stats["num_updates"] == commits
+
+
+def test_process_workers_real_staleness(ds):
+    """DynSGD with process workers: genuinely concurrent processes produce
+    nonzero observed staleness (commits landing between another worker's
+    pull and commit) — the semantics the sync formulation cannot have."""
+    t = dk.DynSGD(make_model(), "sgd", num_workers=2, mode="async",
+                  async_workers="processes", communication_window=2,
+                  **{**COMMON, "num_epoch": 6, "learning_rate": 0.01})
+    m = t.train(ds)
+    assert accuracy(m, ds) > 0.7
+    seen = t.ps_stats["staleness_seen"]
+    assert len(seen) == t.ps_stats["num_updates"]
+    assert max(seen) >= 1, f"no staleness observed across {len(seen)} commits"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_jax_distributed_two_process_smoke(tmp_path):
+    """parallel.multihost.initialize forms a real 2-process jax.distributed
+    cluster (coordinator on localhost) and cross-process collectives work."""
+    script = tmp_path / "dist_child.py"
+    script.write_text(textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {ROOT!r})
+        import jax
+        # env var alone can be clobbered by interpreter startup hooks that
+        # re-point JAX_PLATFORMS at the accelerator; config wins
+        jax.config.update("jax_platforms", "cpu")
+        from distkeras_tpu.parallel import multihost
+        multihost.initialize(coordinator_address=sys.argv[1],
+                             num_processes=2, process_id=int(sys.argv[2]))
+        import numpy as np
+        assert jax.process_count() == 2, jax.process_count()
+        assert jax.process_index() == int(sys.argv[2])
+        from jax.experimental import multihost_utils
+        v = multihost_utils.broadcast_one_to_all(np.asarray([42.0]))
+        assert float(v[0]) == 42.0
+        multihost_utils.sync_global_devices("smoke")
+        print("DIST_OK", jax.process_index())
+    """))
+    addr = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen([sys.executable, str(script), addr, str(k)],
+                              env=env, cwd=ROOT, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT)
+             for k in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out.decode())
+    for k, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {k} failed:\n{out}"
+        assert f"DIST_OK {k}" in out
