@@ -40,6 +40,10 @@ from distkeras_tpu.models import zoo  # noqa: E402
 from distkeras_tpu.trainers import SingleTrainer  # noqa: E402
 
 BATCH = int(os.environ.get("BENCH_BATCH", 1024))
+#: ResNet-20 base width; 16 = the standard He et al. model (the recorded
+#: headline).  Wider variants (scripts/mfu.py ladder) lift MFU toward MXU
+#: granularity — keyed into the anchor so widths never cross-compare.
+WIDTH = int(os.environ.get("BENCH_WIDTH", 16))
 STEPS_PER_EPOCH = 32
 WARMUP_EPOCHS = 2
 TIMED_EPOCHS = int(os.environ.get("BENCH_CALLS", 4))
@@ -61,7 +65,7 @@ def main():
     })
 
     trainer = SingleTrainer(
-        zoo.resnet20(), "sgd", "categorical_crossentropy",
+        zoo.resnet20(width=WIDTH), "sgd", "categorical_crossentropy",
         features_col="features", label_col="label",
         num_epoch=WARMUP_EPOCHS + TIMED_EPOCHS, batch_size=BATCH,
         learning_rate=0.1, compute_dtype="bfloat16")
@@ -75,7 +79,8 @@ def main():
 
     # anchor is keyed by config so overriding BENCH_BATCH can't masquerade
     # as a regression against an incompatible workload
-    cfg_key = f"b{BATCH}_s{STEPS_PER_EPOCH}"
+    cfg_key = f"b{BATCH}_s{STEPS_PER_EPOCH}" + \
+        (f"_w{WIDTH}" if WIDTH != 16 else "")
     anchors = {}
     if os.path.exists(ANCHOR_PATH):
         with open(ANCHOR_PATH) as f:

@@ -52,6 +52,11 @@ class RunConfig:
     dataset_kwargs: dict = dataclasses.field(default_factory=dict)
     onehot: Optional[int] = 10
     test_take: Optional[int] = 1024
+    #: spill the train split to disk shards and stream it
+    #: (``data.streaming.ShardedFileDataset``) instead of training from
+    #: RAM — the BASELINE config-5 "ImageNet-scale input" story.  An int
+    #: is rows per shard; ``true`` uses the default shard size.
+    streaming: Any = None
     trainer_kwargs: dict = dataclasses.field(default_factory=dict)
     quick: dict = dataclasses.field(default_factory=dict)
 
@@ -94,6 +99,10 @@ def build(cfg: RunConfig):
     import distkeras_tpu as dk
     from .data.transformers import OneHotTransformer
 
+    if cfg.streaming and cfg.trainer != "SingleTrainer":
+        raise ValueError(
+            f"streaming: requires trainer SingleTrainer (the only trainer "
+            f"that consumes a ShardedFileDataset), got {cfg.trainer!r}")
     model = getattr(dk.zoo, cfg.model)(**cfg.model_kwargs)
     train, test, _meta = getattr(dk.datasets, cfg.dataset)(
         **cfg.dataset_kwargs)
@@ -102,6 +111,20 @@ def build(cfg: RunConfig):
         train = enc.transform(train)
         test = enc.transform(test)
     test = test.take(int(cfg.test_take)) if cfg.test_take else None
+
+    if cfg.streaming:
+        import atexit
+        import shutil
+        import tempfile
+        from .data.streaming import ShardedFileDataset
+        rows = cfg.streaming if isinstance(cfg.streaming, int) \
+            and not isinstance(cfg.streaming, bool) else 4096
+        spill_dir = tempfile.mkdtemp(prefix="dk_stream_")
+        # the spill is run-scoped scratch, not a dataset the user keeps:
+        # run() removes it eagerly; atexit covers direct build() callers
+        atexit.register(shutil.rmtree, spill_dir, ignore_errors=True)
+        train = ShardedFileDataset.write(train, spill_dir,
+                                         rows_per_shard=rows)
 
     kw = {**_DEFAULT_TRAINER_KW, **cfg.trainer_kwargs}
     if kw.get("num_workers") == "auto":
@@ -120,7 +143,12 @@ def run(cfg: RunConfig) -> dict:
 
     trainer, train, test = build(cfg)
     t0 = time.time()
-    model = trainer.train(train)
+    try:
+        model = trainer.train(train)
+    finally:
+        if cfg.streaming:  # the spill is scratch; free the disk now
+            import shutil
+            shutil.rmtree(train.directory, ignore_errors=True)
     if isinstance(model, list):  # EnsembleTrainer
         model = model[0]
     wall = time.time() - t0

@@ -48,6 +48,7 @@ class ShardedFileDataset:
         self.shards: list = meta["shards"]
         self.num_rows: int = int(meta["num_rows"])
         self.column_names: list = meta["columns"]
+        self._tf_spec_cache: dict = {}  # (cols, batch) -> TensorSpec tuple
 
     # -- construction -------------------------------------------------------
     @staticmethod
@@ -117,9 +118,16 @@ class ShardedFileDataset:
     def _tfdata_batches(self, cols, batch_size, prefetch, seed):
         import tensorflow as tf
         gen = lambda: self._batch_source(cols, batch_size, seed)  # noqa: E731
-        probe = next(self._batch_source(cols, batch_size, seed))
-        spec = tuple(tf.TensorSpec((batch_size, *a.shape[1:]), a.dtype)
-                     for a in probe)
+        # shapes/dtypes don't change per epoch: probe once per
+        # (cols, batch) and cache — the probe reads a whole shard, which
+        # the per-epoch caller must not pay repeatedly
+        key = (tuple(cols), batch_size)
+        spec = self._tf_spec_cache.get(key)
+        if spec is None:
+            probe = next(self._batch_source(cols, batch_size, None))
+            spec = tuple(tf.TensorSpec((batch_size, *a.shape[1:]), a.dtype)
+                         for a in probe)
+            self._tf_spec_cache[key] = spec
         ds = tf.data.Dataset.from_generator(gen, output_signature=spec)
         ds = ds.prefetch(tf.data.AUTOTUNE)
         return ((tuple(t.numpy() for t in item)) for item in ds)
@@ -136,24 +144,43 @@ def _has_tf() -> bool:
 def _prefetched(it: Iterator, depth: int) -> Iterator:
     """Run ``it`` in a producer thread with a bounded queue: disk reads
     overlap consumer (device) work; memory stays bounded at ``depth``
-    batches."""
+    batches.
+
+    The consumer may abandon the iterator mid-epoch (the trainer takes
+    exactly ``n_windows * w`` batches and drops the rest): generator
+    close/GC sets ``stop``, the producer's blocked ``put`` times out and
+    the thread exits instead of pinning the current shard forever."""
     q: queue.Queue = queue.Queue(maxsize=max(1, depth))
     _END = object()
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def produce():
         try:
             for item in it:
-                q.put(item)
-            q.put(_END)
+                if not put(item):
+                    return
+            put(_END)
         except BaseException as e:  # surfaced on the consumer side
-            q.put(e)
+            put(e)
 
     t = threading.Thread(target=produce, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is _END:
-            return
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()

@@ -84,13 +84,18 @@ def _basic_block(filters: int, stride: int = 1, in_filters: int = None):
     return Residual(inner, shortcut, activation="relu")
 
 
-def resnet20(num_classes: int = 10) -> Model:
+def resnet20(num_classes: int = 10, width: int = 16) -> Model:
     """ResNet-20 for CIFAR-10 (He et al. 2015 §4.2: n=3 → 6n+2=20 layers,
     widths 16/32/64).  The DOWNPOUR benchmark config and the headline
-    samples/sec/chip model."""
-    layers = [Conv2D(16, 3, use_bias=False), BatchNorm(), Activation("relu")]
-    widths = [16, 32, 64]
-    in_f = 16
+    samples/sec/chip model.
+
+    ``width`` scales the stage widths ``[w, 2w, 4w]`` (16 = the standard
+    model).  Wider variants put MXU-granular channel counts (≥128 lanes)
+    on the matmul dimensions — the scripts/mfu.py utilization ladder."""
+    layers = [Conv2D(width, 3, use_bias=False), BatchNorm(),
+              Activation("relu")]
+    widths = [width, 2 * width, 4 * width]
+    in_f = width
     for si, f in enumerate(widths):
         for bi in range(3):
             stride = 2 if (si > 0 and bi == 0) else 1

@@ -177,7 +177,7 @@ def _worker_env() -> dict:
     return env
 
 
-def _spawn(spec: dict, td: str, k: int, timeout) -> subprocess.Popen:
+def _spawn(spec: dict, td: str, k: int) -> subprocess.Popen:
     spec_path = os.path.join(td, f"worker_{k}_{spec['attempt']}.spec")
     with open(spec_path, "wb") as f:
         f.write(serde.tree_to_bytes(spec))
@@ -189,6 +189,20 @@ def _spawn(spec: dict, td: str, k: int, timeout) -> subprocess.Popen:
 def _run_process_workers(trainer, ps, server, mode, center, xs, ys,
                          num_epoch, start_windows, timeout: float = 1800.0):
     model_blob = serde.serialize_model(trainer.model, center)
+    if not isinstance(trainer.worker_optimizer, str):
+        # thread placement accepts optimizer OBJECTS (they stay in-process);
+        # a process worker rebuilds its optimizer from the spec, so only
+        # names ship — substituting a default would silently train
+        # different math than the threads placement
+        raise ValueError(
+            "async_workers='processes' requires a string worker_optimizer "
+            f"(got {type(trainer.worker_optimizer).__name__}); optimizer "
+            "objects cannot be shipped to worker processes")
+    if not isinstance(trainer.loss, str):
+        raise ValueError(
+            "async_workers='processes' requires a string loss (got "
+            f"{type(trainer.loss).__name__}); loss callables cannot be "
+            "shipped to worker processes")
 
     def make_spec(k: int, blob: bytes, seed: int, td: str, attempt: int,
                   start_window: int):
@@ -197,8 +211,7 @@ def _run_process_workers(trainer, ps, server, mode, center, xs, ys,
             np.savez(data, xs=xs[k], ys=ys[k])
         return {
             "model_blob": blob,
-            "worker_optimizer": trainer.worker_optimizer
-            if isinstance(trainer.worker_optimizer, str) else "sgd",
+            "worker_optimizer": trainer.worker_optimizer,
             "loss": trainer.loss,
             "learning_rate": trainer.learning_rate,
             "compute_dtype": str(trainer.compute_dtype)
@@ -221,24 +234,38 @@ def _run_process_workers(trainer, ps, server, mode, center, xs, ys,
         specs = [make_spec(k, model_blob, trainer.seed + 1 + k, td, 0,
                            start_windows[k])
                  for k in range(trainer.num_workers)]
-        procs = [_spawn(s, td, k, timeout) for k, s in enumerate(specs)]
-        for p in procs:
-            p.wait(timeout=timeout)
-        losses = []
-        # Spark-style single retry from the current center, continuing at
-        # the exact window the dead process's commits reached (thread path
-        # has the same rule)
-        for k, p in enumerate(procs):
-            if p.returncode == 0:
-                losses.append(read_epochs(specs[k]["out_npz"]))
-                continue
-            fresh = serde.serialize_model(trainer.model, ps.get_model())
-            specs[k] = make_spec(k, fresh, trainer.seed + 101 + k, td, 1,
-                                 ps.commits_by_worker.get(k, 0))
-            retry = _spawn(specs[k], td, k, timeout)
-            retry.wait(timeout=timeout)
-            if retry.returncode != 0:
-                raise RuntimeError(f"async worker process {k} failed twice "
-                                   f"(rc={retry.returncode})")
-            losses.append(read_epochs(specs[k]["out_npz"]))
+        procs = [_spawn(s, td, k) for k, s in enumerate(specs)]
+        try:
+            for p in procs:
+                p.wait(timeout=timeout)
+            losses = []
+            # Spark-style single retry from the current center, continuing
+            # at the exact window the dead process's commits reached
+            # (thread path has the same rule)
+            for k, p in enumerate(procs):
+                if p.returncode == 0:
+                    losses.append(read_epochs(specs[k]["out_npz"]))
+                    continue
+                # epochs attempt 0 completed before dying (worker_main
+                # writes them even on failure) merge with the retry's —
+                # same rule as the thread placement
+                prior = read_epochs(specs[k]["out_npz"]) \
+                    if os.path.exists(specs[k]["out_npz"]) else {}
+                fresh = serde.serialize_model(trainer.model, ps.get_model())
+                specs[k] = make_spec(k, fresh, trainer.seed + 101 + k, td, 1,
+                                     ps.commits_by_worker.get(k, 0))
+                retry = _spawn(specs[k], td, k)
+                procs[k] = retry
+                retry.wait(timeout=timeout)
+                if retry.returncode != 0:
+                    raise RuntimeError(f"async worker process {k} failed "
+                                       f"twice (rc={retry.returncode})")
+                losses.append({**prior,
+                               **read_epochs(specs[k]["out_npz"])})
+        finally:
+            # a hung/failed worker must not orphan its siblings
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
     return losses

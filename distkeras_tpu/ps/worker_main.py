@@ -72,11 +72,13 @@ def run_spec(spec_path: str) -> None:
         start_window=int(spec.get("start_window", 0)), **kw)
     worker.set_data(xs, ys)
     worker.run()  # synchronously in THIS process (it is the worker process)
-    if worker.error is not None:
-        raise worker.error
-
+    # write the complete epochs this attempt produced BEFORE surfacing any
+    # failure: the runner merges them with the retry's epochs, so a crash
+    # mid-epoch-1 doesn't lose epoch 0 (thread-placement parity)
     np.savez(spec["out_npz"],
              **{f"epoch_{e}": l for e, l in worker.epoch_losses.items()})
+    if worker.error is not None:
+        raise worker.error
 
 
 def main(argv=None) -> int:

@@ -93,22 +93,26 @@ class AsyncWorker(threading.Thread):
     def _train(self, client: PSClient):
         n_windows = int(self.xs.shape[0])
         total = self.num_epoch * n_windows
-        for gw in range(self.start_window, total):
-            wi = gw % n_windows  # window within the epoch
-            wx = self._put(self.xs[wi])
-            wy = self._put(self.ys[wi])
-            losses = self._window(client, wx, wy)
-            self.window_losses.append((gw, np.asarray(losses)))
-        # per-epoch view for the COMPLETE epochs this run covered (a
-        # resumed worker may start mid-epoch; that partial epoch is only
-        # in window_losses)
-        by_epoch: dict = {}
-        for gw, l in self.window_losses:
-            by_epoch.setdefault(gw // n_windows, []).append(l)
-        self.epoch_losses = {e: np.stack(ls) for e, ls in by_epoch.items()
-                             if len(ls) == n_windows}
-        self.losses = [self.epoch_losses[e]
-                       for e in sorted(self.epoch_losses)]
+        try:
+            for gw in range(self.start_window, total):
+                wi = gw % n_windows  # window within the epoch
+                wx = self._put(self.xs[wi])
+                wy = self._put(self.ys[wi])
+                losses = self._window(client, wx, wy)
+                self.window_losses.append((gw, np.asarray(losses)))
+        finally:
+            # per-epoch view for the COMPLETE epochs this run covered —
+            # built even on a crash so a retried worker's merge keeps the
+            # epochs this attempt finished (a resumed worker may start
+            # mid-epoch; that partial epoch is only in window_losses)
+            by_epoch: dict = {}
+            for gw, l in self.window_losses:
+                by_epoch.setdefault(gw // n_windows, []).append(l)
+            self.epoch_losses = {e: np.stack(ls)
+                                 for e, ls in by_epoch.items()
+                                 if len(ls) == n_windows}
+            self.losses = [self.epoch_losses[e]
+                           for e in sorted(self.epoch_losses)]
 
     def _run_window(self, wx, wy):
         self.variables, self.opt_state, self.rng, losses = self.window_fn(

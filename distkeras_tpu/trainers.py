@@ -331,14 +331,20 @@ class SingleTrainer(Trainer):
             seed = (self.seed + 1000 + epoch) if shuffle else None
             it = source.batches(cols, bs, seed=seed)
             epoch_losses = []
-            for _ in range(n_windows):
-                window = [next(it) for _ in range(w)]
-                wx = np.stack([b[0] for b in window])
-                wy = np.stack([b[1] for b in window])
-                variables, opt_state, rng, losses = run(
-                    variables, opt_state, rng, jnp.asarray(wx),
-                    jnp.asarray(wy))
-                epoch_losses.append(losses)
+            try:
+                for _ in range(n_windows):
+                    window = [next(it) for _ in range(w)]
+                    wx = np.stack([b[0] for b in window])
+                    wy = np.stack([b[1] for b in window])
+                    variables, opt_state, rng, losses = run(
+                        variables, opt_state, rng, jnp.asarray(wx),
+                        jnp.asarray(wy))
+                    epoch_losses.append(losses)
+            finally:
+                # the epoch takes exactly n_windows*w batches; close the
+                # stream so the prefetch thread releases its shard now
+                if hasattr(it, "close"):
+                    it.close()
             pipe.push(epoch, jnp.concatenate(epoch_losses))
             if ckpt is not None:
                 ckpt.save(epoch, (variables, opt_state, rng),

@@ -13,11 +13,12 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH_YAML = os.path.join(ROOT, "configs", "bench_all.yaml")
 
 
-def test_bench_yaml_loads_all_five():
+def test_bench_yaml_loads_all_configs():
     cfgs = cfg_mod.load_file(BENCH_YAML)
-    assert len(cfgs) == 5
+    assert len(cfgs) == 6  # five BASELINE configs + streaming variant of #5
     assert [c.trainer for c in cfgs] == [
-        "SingleTrainer", "ADAG", "DOWNPOUR", "AEASGD", "DynSGD"]
+        "SingleTrainer", "ADAG", "DOWNPOUR", "AEASGD", "DynSGD",
+        "SingleTrainer"]
     # every config builds a real trainer of the right class with the right
     # hyperparameters (quick variant keeps data small)
     c = cfgs[1].with_quick()
@@ -27,6 +28,30 @@ def test_bench_yaml_loads_all_five():
     assert trainer.communication_window == 4
     assert train.num_rows == 2048
     assert test.num_rows == 1024
+
+
+def test_streaming_config_trains_from_disk():
+    """``streaming:`` spills the train split to .npz shards; the trainer
+    consumes the ShardedFileDataset (config 5's disk-backed input story)."""
+    from distkeras_tpu.data.streaming import ShardedFileDataset
+    c = RunConfig(name="stream tiny", trainer="SingleTrainer",
+                  model="mlp_mnist", model_kwargs={"hidden": 32},
+                  dataset="load_mnist", dataset_kwargs={"n_train": 1024},
+                  onehot=10, test_take=256, streaming=256,
+                  trainer_kwargs={"num_epoch": 2, "batch_size": 64,
+                                  "learning_rate": 0.1})
+    trainer, train, test = cfg_mod.build(c)
+    assert isinstance(train, ShardedFileDataset)
+    assert len(train.shards) == 4
+    row = cfg_mod.run(c)
+    assert row["accuracy"] > 0.7
+    assert row["samples_per_sec"] > 0
+
+
+def test_streaming_requires_single_trainer():
+    c = RunConfig(name="x", trainer="DynSGD", streaming=True)
+    with pytest.raises(ValueError, match="streaming: requires"):
+        cfg_mod.build(c)
 
 
 def test_quick_overrides_merge_not_replace():

@@ -58,6 +58,18 @@ def test_process_workers_real_staleness(ds):
     assert max(seen) >= 1, f"no staleness observed across {len(seen)} commits"
 
 
+def test_process_workers_reject_optimizer_objects(ds):
+    """Optimizer OBJECTS cannot ship to worker processes; substituting a
+    default would silently train different math than the threads
+    placement — it must raise instead."""
+    import optax
+    t = dk.DOWNPOUR(make_model(), optax.sgd(0.05), num_workers=2,
+                    mode="async", async_workers="processes",
+                    communication_window=4, **COMMON)
+    with pytest.raises(ValueError, match="string worker_optimizer"):
+        t.train(ds)
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))

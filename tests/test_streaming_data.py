@@ -74,6 +74,28 @@ def test_single_trainer_streams_from_disk(ds, tmp_path):
     assert hist[-1] < hist[0]
 
 
+def test_prefetch_thread_exits_when_iterator_abandoned(ds, tmp_path):
+    """The trainer takes exactly n_windows*w batches then drops the
+    iterator; the producer thread must exit (releasing its shard) instead
+    of blocking forever on a full queue."""
+    import threading
+    import time
+
+    src = _write(ds, tmp_path)
+    before = set(threading.enumerate())
+    it = src.batches(["features"], 64, engine="thread", prefetch=2)
+    next(it)  # producer is now running and the queue fills
+    it.close()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        extra = [t for t in threading.enumerate()
+                 if t not in before and t.is_alive()]
+        if not extra:
+            break
+        time.sleep(0.05)
+    assert not extra, f"prefetch thread leaked: {extra}"
+
+
 def test_streaming_resume(ds, tmp_path):
     src = _write(ds, tmp_path)
     cdir = str(tmp_path / "ck")
