@@ -1,0 +1,122 @@
+"""Pipeline parallelism (GPipe schedule) over the ``pp`` mesh axis.
+
+Absent from the reference (SURVEY.md §2 parallelism inventory: data
+parallelism only) but first-class here: a stack of S structurally
+identical stages is laid out one-stage-per-device along ``pp``; M
+microbatches flow through the pipeline, activations hopping to the next
+stage via ``lax.ppermute`` (neighbor traffic — rides ICI, never a host).
+
+The whole schedule — fill, steady state, drain: M + S − 1 ticks — is ONE
+``lax.scan`` inside ONE ``shard_map``-ed jit program, so XLA sees a
+static loop and overlaps each tick's compute with the activation
+ppermute.  Bubble ticks compute on garbage and are masked out of the
+result (the classic GPipe trade: bubble fraction (S−1)/(M+S−1); raise M
+to amortize).  Reverse-mode AD simply runs the scan backward —
+activations re-flow through the inverse permutation, giving backward
+pipelining without any hand-written schedule.
+
+Stage contract: ``stage_fn(stage_params, x) -> y`` with ``x`` and ``y``
+the same shape (homogeneous blocks — transformer layers, residual MLP
+blocks).  This is the standard constraint of SPMD pipelining: one
+program runs on every device, so every stage must be the same program
+with different weights.
+
+Ref (pattern): jax shard_map pipelining idiom; GPipe (Huang et al. 2019)
+for the schedule.  No reference-code equivalent exists (SURVEY.md §2:
+strategy ABSENT upstream).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import shard_map
+from .sync import _shard_map_kw
+
+Tree = Any
+
+
+def stack_stage_params(stage_params: Sequence[Tree]) -> Tree:
+    """Stack S per-stage param pytrees into one tree with a leading
+    (stage,) axis — the layout ``pipeline_apply_sharded`` shards over
+    ``pp``."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *stage_params)
+
+
+def pipeline_apply(stage_fn: Callable, stage_params: Tree, x_mb, *,
+                   axis_name: str = "pp"):
+    """GPipe forward; call INSIDE ``shard_map``.
+
+    ``stage_params``: this device's stage (leaves carry a leading
+    singleton stage axis, as produced by a ``P(axis_name)`` in_spec on
+    the stacked tree).  ``x_mb``: the full (M, mb, ...) microbatch stack,
+    replicated.  Returns (M, mb, ...) outputs, replicated (psum'd off the
+    last stage).
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage_idx = lax.axis_index(axis_name)
+    params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    n_micro = x_mb.shape[0]
+    ticks = n_micro + n_stages - 1
+    fwd = [(j, j + 1) for j in range(n_stages - 1)]  # non-cyclic: 0 gets 0s
+
+    def tick(carry, t):
+        state, out = carry
+        # stage 0 injects microbatch t while any remain; later stages use
+        # the activation ppermuted in from the previous stage last tick
+        inject = x_mb[jnp.clip(t, 0, n_micro - 1)]
+        state = jnp.where((stage_idx == 0) & (t < n_micro), inject, state)
+        y = stage_fn(params, state)
+        # at tick t this stage holds microbatch m = t - stage_idx
+        m = t - stage_idx
+        is_last = stage_idx == n_stages - 1
+        valid = is_last & (m >= 0) & (m < n_micro)
+        mc = jnp.clip(m, 0, n_micro - 1)
+        out = lax.dynamic_update_index_in_dim(
+            out, jnp.where(valid, y, lax.dynamic_index_in_dim(
+                out, mc, keepdims=False)), mc, 0)
+        state = lax.ppermute(y, axis_name, fwd)
+        return (state, out), None
+
+    state0 = jnp.zeros_like(x_mb[0])
+    out0 = jnp.zeros((n_micro,) + x_mb.shape[1:], x_mb.dtype)
+    (_, out), _ = lax.scan(tick, (state0, out0), jnp.arange(ticks))
+    # results live on the last stage only; broadcast so every device
+    # returns the same (replicated) output
+    return lax.psum(jnp.where(stage_idx == n_stages - 1, out, 0), axis_name)
+
+
+def pipeline_apply_sharded(mesh: Mesh, stage_fn: Callable,
+                           stacked_params: Tree, x, *,
+                           num_microbatches: int, axis: str = "pp"):
+    """Whole-array entry point: run S = ``mesh.shape[axis]`` stages over
+    the pipeline.  ``stacked_params``: leading (S, ...) stage axis on
+    every leaf (see :func:`stack_stage_params`).  ``x``: (B, ...) with B
+    divisible by ``num_microbatches``.  Returns (B, ...)."""
+    n_stages = mesh.shape[axis]
+    batch = x.shape[0]
+    if batch % num_microbatches:
+        raise ValueError(f"batch {batch} not divisible by "
+                         f"num_microbatches {num_microbatches}")
+    lead = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if lead != n_stages:
+        raise ValueError(f"stacked_params lead dim {lead} != pipeline "
+                         f"stages {n_stages} (mesh axis {axis!r})")
+    x_mb = x.reshape(num_microbatches, batch // num_microbatches,
+                     *x.shape[1:])
+    param_specs = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    fn = shard_map(
+        partial(pipeline_apply, stage_fn, axis_name=axis),
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        **_shard_map_kw())
+    out = fn(stacked_params, x_mb)
+    return out.reshape(batch, *out.shape[2:])

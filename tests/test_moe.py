@@ -1,0 +1,131 @@
+"""Expert parallelism: switch-MoE over the ``ep`` mesh axis.
+
+The reference has NO expert parallelism (SURVEY.md §2: strategy ABSENT);
+this is a TPU-native extension.  Correctness bar: with capacity high
+enough that nothing drops, the all_to_all-dispatched sharded MoE must
+equal the dense per-token formula out_n = gate_n · FFN_{e(n)}(x_n) —
+forward and gradients.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu.ops.moe import init_moe_params, switch_moe_sharded
+from distkeras_tpu.parallel.mesh import make_mesh
+
+D, H, N = 8, 16, 64
+
+
+def dense_reference(params, x):
+    """Per-token top-1 expert, no capacity limit."""
+    wg = params["router"]["wg"]
+    ex = params["experts"]
+    gates = jax.nn.softmax(x @ wg, axis=-1)
+    idx = jnp.argmax(gates, axis=-1)
+    gate = jnp.take_along_axis(gates, idx[:, None], 1)[:, 0]
+    h = jax.nn.relu(jnp.einsum("nd,edh->neh", x, ex["w1"]) + ex["b1"])
+    y = jnp.einsum("neh,ehd->ned", h, ex["w2"]) + ex["b2"]
+    picked = jnp.take_along_axis(y, idx[:, None, None], 1)[:, 0]
+    return gate[:, None] * picked
+
+
+@pytest.fixture(scope="module")
+def mesh(devices):
+    return make_mesh(8, ("ep",))
+
+
+@pytest.mark.parametrize("num_experts", [8, 16])
+def test_moe_matches_dense_reference(mesh, num_experts):
+    params = init_moe_params(0, num_experts, D, H)
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(N, D)),
+                    jnp.float32)
+    # capacity ≥ any possible per-device per-expert load → no drops
+    out, aux = switch_moe_sharded(mesh, params, x,
+                                  capacity_factor=2.0 * num_experts)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(dense_reference(params, x)),
+                               rtol=1e-5, atol=1e-5)
+    assert float(aux) >= 1.0 - 1e-5  # = 1 iff perfectly balanced
+
+
+def test_moe_gradients_match_dense_reference(mesh):
+    params = init_moe_params(2, 8, D, H)
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(N, D)),
+                    jnp.float32)
+
+    def sharded_loss(p):
+        out, _ = switch_moe_sharded(mesh, p, x, capacity_factor=16.0)
+        return jnp.mean(out ** 2)
+
+    def dense_loss(p):
+        return jnp.mean(dense_reference(p, x) ** 2)
+
+    gs = jax.grad(sharded_loss)(params)
+    gd = jax.grad(dense_loss)(params)
+    for a, b in zip(jax.tree_util.tree_leaves(gs),
+                    jax.tree_util.tree_leaves(gd)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_moe_capacity_drops_tokens(mesh):
+    """Overflow tokens get ZERO output (the switch contract: callers add
+    a residual), never garbage."""
+    params = init_moe_params(4, 8, D, H)
+    x = jnp.asarray(np.random.default_rng(5).normal(size=(N, D)),
+                    jnp.float32)
+    out, _ = switch_moe_sharded(mesh, params, x, capacity_factor=0.125)
+    dense = np.asarray(dense_reference(params, x))
+    got = np.asarray(out)
+    zero_rows = np.all(got == 0.0, axis=1)
+    assert zero_rows.any(), "expected overflow drops at capacity 1"
+    kept = ~zero_rows
+    np.testing.assert_allclose(got[kept], dense[kept], rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_moe_bf16_tokens(mesh):
+    """Slot bookkeeping stays int32 regardless of token dtype (bf16 can't
+    count past 256 exactly); outputs track the f32 path."""
+    params = init_moe_params(8, 8, D, H)
+    xf = jnp.asarray(np.random.default_rng(9).normal(size=(N, D)),
+                     jnp.float32)
+    out_f, _ = switch_moe_sharded(mesh, params, xf, capacity_factor=16.0)
+    out_b, _ = switch_moe_sharded(mesh, params, xf.astype(jnp.bfloat16),
+                                  capacity_factor=16.0)
+    np.testing.assert_allclose(np.asarray(out_b, np.float32),
+                               np.asarray(out_f), rtol=0.1, atol=0.05)
+
+
+def test_moe_validates_shapes(mesh):
+    params = init_moe_params(10, 8, D, H)
+    with pytest.raises(ValueError, match="not divisible"):
+        switch_moe_sharded(mesh, params, jnp.zeros((60, D)))
+    with pytest.raises(ValueError, match="experts not divisible"):
+        switch_moe_sharded(mesh, init_moe_params(10, 12, D, H),
+                           jnp.zeros((64, D)))
+
+
+def test_moe_trains_and_balances(mesh):
+    """jitted SGD through router + experts: task loss falls and the aux
+    loss keeps routing near balanced."""
+    params = init_moe_params(6, 8, D, H)
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(N, D)), jnp.float32)
+    tgt = jnp.asarray(np.tanh(rng.normal(size=(N, D))), jnp.float32)
+
+    @jax.jit
+    def step(p):
+        def loss(p):
+            out, aux = switch_moe_sharded(mesh, p, x, capacity_factor=2.0)
+            return jnp.mean((x + out - tgt) ** 2) + 0.01 * aux
+        l, g = jax.value_and_grad(loss)(p)
+        return jax.tree_util.tree_map(lambda w, d: w - 0.2 * d, p, g), l
+
+    losses = []
+    for _ in range(60):
+        params, l = step(params)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.85, losses
