@@ -67,13 +67,27 @@ def pipeline_apply(stage_fn: Callable, stage_params: Tree, x_mb, *,
     ticks = n_micro + n_stages - 1
     fwd = [(j, j + 1) for j in range(n_stages - 1)]  # non-cyclic: 0 gets 0s
 
+    # stage output aval: activations may promote past the token dtype
+    # (bf16 tokens × f32 params → f32) — the carry/out buffers must live
+    # in the promoted (fixed-point) dtype or the scan dtypes mismatch
+    y_aval = jax.eval_shape(stage_fn, params,
+                            jax.ShapeDtypeStruct(x_mb.shape[1:],
+                                                 x_mb.dtype))
+    y_aval = jax.eval_shape(stage_fn, params,
+                            jax.ShapeDtypeStruct(x_mb.shape[1:],
+                                                 y_aval.dtype))
+    if y_aval.shape != x_mb.shape[1:]:
+        raise ValueError(
+            f"stage_fn must preserve the activation shape (homogeneous "
+            f"stages): got {y_aval.shape} from {x_mb.shape[1:]}")
+
     def tick(carry, t):
         state, out = carry
         # stage 0 injects microbatch t while any remain; later stages use
         # the activation ppermuted in from the previous stage last tick
-        inject = x_mb[jnp.clip(t, 0, n_micro - 1)]
+        inject = x_mb[jnp.clip(t, 0, n_micro - 1)].astype(y_aval.dtype)
         state = jnp.where((stage_idx == 0) & (t < n_micro), inject, state)
-        y = stage_fn(params, state)
+        y = stage_fn(params, state).astype(y_aval.dtype)
         # at tick t this stage holds microbatch m = t - stage_idx
         m = t - stage_idx
         is_last = stage_idx == n_stages - 1
@@ -85,8 +99,8 @@ def pipeline_apply(stage_fn: Callable, stage_params: Tree, x_mb, *,
         state = lax.ppermute(y, axis_name, fwd)
         return (state, out), None
 
-    state0 = jnp.zeros_like(x_mb[0])
-    out0 = jnp.zeros((n_micro,) + x_mb.shape[1:], x_mb.dtype)
+    state0 = jnp.zeros(x_mb.shape[1:], y_aval.dtype)
+    out0 = jnp.zeros((n_micro,) + x_mb.shape[1:], y_aval.dtype)
     (_, out), _ = lax.scan(tick, (state0, out0), jnp.arange(ticks))
     # results live on the last stage only; broadcast so every device
     # returns the same (replicated) output
@@ -95,11 +109,18 @@ def pipeline_apply(stage_fn: Callable, stage_params: Tree, x_mb, *,
 
 def pipeline_apply_sharded(mesh: Mesh, stage_fn: Callable,
                            stacked_params: Tree, x, *,
-                           num_microbatches: int, axis: str = "pp"):
+                           num_microbatches: int, axis: str = "pp",
+                           dp_axis: str | None = None):
     """Whole-array entry point: run S = ``mesh.shape[axis]`` stages over
     the pipeline.  ``stacked_params``: leading (S, ...) stage axis on
     every leaf (see :func:`stack_stage_params`).  ``x``: (B, ...) with B
-    divisible by ``num_microbatches``.  Returns (B, ...)."""
+    divisible by ``num_microbatches``.  Returns (B, ...).
+
+    ``dp_axis``: optional second mesh axis to ALSO shard each
+    microbatch's batch dim over — pp×dp composition: every dp replica
+    runs the same pipeline schedule on its slice of every microbatch
+    (params replicated across ``dp_axis``; the caller's grad psum over
+    ``dp_axis`` falls out of AD through the sharded batch)."""
     n_stages = mesh.shape[axis]
     batch = x.shape[0]
     if batch % num_microbatches:
@@ -109,14 +130,18 @@ def pipeline_apply_sharded(mesh: Mesh, stage_fn: Callable,
     if lead != n_stages:
         raise ValueError(f"stacked_params lead dim {lead} != pipeline "
                          f"stages {n_stages} (mesh axis {axis!r})")
-    x_mb = x.reshape(num_microbatches, batch // num_microbatches,
-                     *x.shape[1:])
+    mb = batch // num_microbatches
+    if dp_axis is not None and mb % mesh.shape[dp_axis]:
+        raise ValueError(f"microbatch size {mb} not divisible by the "
+                         f"{dp_axis!r} axis size {mesh.shape[dp_axis]}")
+    x_mb = x.reshape(num_microbatches, mb, *x.shape[1:])
     param_specs = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    data_spec = P(None, dp_axis) if dp_axis is not None else P()
     fn = shard_map(
         partial(pipeline_apply, stage_fn, axis_name=axis),
         mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(),
+        in_specs=(param_specs, data_spec),
+        out_specs=data_spec,
         **_shard_map_kw())
     out = fn(stacked_params, x_mb)
     return out.reshape(batch, *out.shape[2:])

@@ -96,14 +96,25 @@ def _shard_map_kw():
 
 def make_local_step(model, loss_fn: Callable,
                     optimizer: optax.GradientTransformation,
-                    compute_dtype=None):
+                    compute_dtype=None, remat: bool = False):
     """One minibatch of local optimization as a pure scan-able function:
     ``step((variables, opt_state, rng), (x, y)) -> (carry', loss)``.
 
     This is the reference's ``model.train_on_batch`` (reference
     ``distkeras/workers.py``) as a jit-compiled value_and_grad + optax
     update — the MXU hot loop.
+
+    ``remat=True`` wraps the forward in ``jax.checkpoint``: activations
+    are recomputed during the backward pass instead of living in HBM for
+    the whole step — the standard FLOPs-for-memory trade for models whose
+    activation footprint, not weights, is what OOMs.
     """
+
+    def forward(params, state, x, rng):
+        return model.layer.apply(params, state, x, train=True, rng=rng)
+
+    if remat:
+        forward = jax.checkpoint(forward)
 
     def step(carry, batch):
         variables, opt_state, rng = carry
@@ -113,8 +124,7 @@ def make_local_step(model, loss_fn: Callable,
         rng, sub = jax.random.split(rng)
 
         def loss_of(params):
-            out, new_state = model.layer.apply(
-                params, variables["state"], x, train=True, rng=sub)
+            out, new_state = forward(params, variables["state"], x, sub)
             return loss_fn(out, y), new_state
 
         (loss_val, new_state), grads = jax.value_and_grad(
@@ -127,7 +137,8 @@ def make_local_step(model, loss_fn: Callable,
     return step
 
 
-def make_window_fn(model, loss_fn, optimizer, compute_dtype=None):
+def make_window_fn(model, loss_fn, optimizer, compute_dtype=None,
+                   remat: bool = False):
     """jit-compiled window scan: ``(variables, opt_state, rng, xs, ys) ->
     (variables, opt_state, rng, losses)`` over the leading (steps) axis —
     the unit of work between two parameter-server interactions.
@@ -135,7 +146,7 @@ def make_window_fn(model, loss_fn, optimizer, compute_dtype=None):
     Carry buffers are donated: params/opt-state update in place in HBM
     (callers all rebind to the outputs, measured ~4% on ResNet-20).
     """
-    step = make_local_step(model, loss_fn, optimizer, compute_dtype)
+    step = make_local_step(model, loss_fn, optimizer, compute_dtype, remat)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def run(variables, opt_state, rng, xs, ys):
@@ -270,7 +281,7 @@ class SyncEngine:
     def __init__(self, model, loss_fn: Callable, optimizer: optax.GradientTransformation,
                  algo: SyncAlgorithm, num_workers: int, window: int,
                  mesh: Optional[Mesh] = None, axis: str = "workers",
-                 compute_dtype=None):
+                 compute_dtype=None, remat: bool = False):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -281,7 +292,7 @@ class SyncEngine:
         self.mesh = mesh if mesh is not None else make_mesh(num_workers, (axis,))
         self.compute_dtype = compute_dtype
         self._local_step = make_local_step(model, loss_fn, optimizer,
-                                           compute_dtype)
+                                           compute_dtype, remat)
 
     # -- distributed epoch --------------------------------------------------
     def epoch_fn(self):

@@ -107,7 +107,8 @@ def _run_thread_workers(trainer, ps, server, mode, center, xs, ys, num_epoch,
                         start_windows):
     loss_fn, optimizer = trainer._resolve()
     window_fn = make_window_fn(trainer.model, loss_fn, optimizer,
-                               compute_dtype=trainer.compute_dtype)
+                               compute_dtype=trainer.compute_dtype,
+                               remat=trainer.remat)
     worker_cls = _WORKER_CLASSES[mode]
     devices = jax.devices()
     workers = []
@@ -216,6 +217,7 @@ def _run_process_workers(trainer, ps, server, mode, center, xs, ys,
             "learning_rate": trainer.learning_rate,
             "compute_dtype": str(trainer.compute_dtype)
             if trainer.compute_dtype is not None else None,
+            "remat": bool(trainer.remat),
             "mode": mode,
             "alpha": float(getattr(trainer, "alpha", 0.0)),
             "worker_id": k, "host": "127.0.0.1", "port": server.port,

@@ -53,10 +53,12 @@ def run_spec(spec_path: str) -> None:
     # detection included) so process workers train the same math as threads
     shim = Trainer(model, spec["worker_optimizer"], spec["loss"],
                    learning_rate=spec["learning_rate"],
-                   compute_dtype=spec.get("compute_dtype"))
+                   compute_dtype=spec.get("compute_dtype"),
+                   remat=bool(spec.get("remat", False)))
     loss_fn, optimizer = shim._resolve()
     window_fn = make_window_fn(model, loss_fn, optimizer,
-                               compute_dtype=shim.compute_dtype)
+                               compute_dtype=shim.compute_dtype,
+                               remat=shim.remat)
 
     with np.load(spec["data_npz"]) as d:
         xs, ys = d["xs"], d["ys"]

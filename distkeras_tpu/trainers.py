@@ -134,7 +134,7 @@ class Trainer:
                  batch_size: int = 32, learning_rate: float = 0.01,
                  seed: int = 0, checkpoint_dir: Optional[str] = None,
                  checkpoint_keep: int = 3, metrics=None,
-                 compute_dtype=None):
+                 compute_dtype=None, remat: bool = False):
         self.model = keras_model
         self.worker_optimizer = worker_optimizer
         self.loss = loss
@@ -151,6 +151,11 @@ class Trainer:
         #: the activation dtype at use, so matmuls/convs hit the MXU in
         #: e.g. bfloat16 while the master copy keeps full precision).
         self.compute_dtype = _resolve_dtype(compute_dtype)
+        #: rematerialization (jax.checkpoint around the forward): trade
+        #: recompute FLOPs for activation HBM — for deep models whose
+        #: activations, not weights, are what OOMs (SURVEY.md §7 /
+        #: scaling-book memory recipe)
+        self.remat = bool(remat)
         if metrics is None or isinstance(metrics, MetricsLogger):
             self.metrics = metrics or MetricsLogger(None)
         else:
@@ -195,7 +200,7 @@ class Trainer:
         o, l = self.worker_optimizer, self.loss
         return (o if isinstance(o, str) else id(o),
                 l if isinstance(l, str) else id(l),
-                self.learning_rate, str(self.compute_dtype))
+                self.learning_rate, str(self.compute_dtype), self.remat)
 
     def _window_run(self):
         """Cached jit window program — repeated ``train()`` calls on an
@@ -206,7 +211,8 @@ class Trainer:
         if cached is None or cached[0] != key:
             loss_fn, optimizer = self._resolve()
             run = make_window_fn(self.model, loss_fn, optimizer,
-                                 compute_dtype=self.compute_dtype)
+                                 compute_dtype=self.compute_dtype,
+                                 remat=self.remat)
             self._run_cache = (key, run, optimizer)
         return self._run_cache[1:]
 
@@ -453,7 +459,8 @@ class DistributedTrainer(Trainer):
             engine = SyncEngine(self.model, loss_fn, optimizer,
                                 self._sync_algorithm(), self.num_workers,
                                 self.communication_window, mesh=mesh,
-                                compute_dtype=self.compute_dtype)
+                                compute_dtype=self.compute_dtype,
+                                remat=self.remat)
             self._engine_cache = (key, engine.epoch_fn(), mesh, optimizer)
         return self._engine_cache[1:]
 
