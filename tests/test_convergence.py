@@ -6,8 +6,11 @@ SingleTrainer anchor.
 A FAST subset (SingleTrainer anchor + sync ADAG + async DOWNPOUR, ~20s)
 runs in the DEFAULT suite so the convergence gate actually fires on every
 test run; the full matrix keeps the ``convergence`` marker (``pytest -m
-convergence``).  Set ``RECORD_CONVERGENCE=path.md`` to write the measured
-accuracy table as a round artifact.
+convergence``).  To record the round artifact run the WHOLE file with the
+marker filter cleared (the fast subset is otherwise deselected out of the
+table)::
+
+    RECORD_CONVERGENCE=CONVERGENCE.md pytest tests/test_convergence.py -m ''
 """
 
 import os
