@@ -121,6 +121,19 @@ class Layer:
     def apply(self, params, state, x, *, train: bool = False, rng=None):
         raise NotImplementedError
 
+    def iter_layers(self):
+        """Yield this layer and every nested layer (depth-first through
+        the composition attributes: ``layers``, ``inner``, ``shortcut``).
+        The public way to find/configure layers inside a built model —
+        e.g. attaching a mesh to every ``MoEDense``."""
+        yield self
+        for sub in getattr(self, "layers", None) or []:
+            yield from sub.iter_layers()
+        for attr in ("inner", "shortcut"):
+            sub = getattr(self, attr, None)
+            if isinstance(sub, Layer):
+                yield from sub.iter_layers()
+
     # -- config serde -------------------------------------------------------
     def get_config(self) -> dict:
         return {}

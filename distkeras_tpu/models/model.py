@@ -43,6 +43,10 @@ class Model:
         return self.layer.apply(variables["params"], variables["state"], x,
                                 train=train, rng=rng)
 
+    def iter_layers(self):
+        """All layers in the model, depth-first (``Layer.iter_layers``)."""
+        return self.layer.iter_layers()
+
     def predict_fn(self):
         """Pure inference function suitable for jit: (variables, x) -> y."""
         def fn(variables, x):

@@ -163,21 +163,30 @@ def lstm_imdb(vocab_size: int = 20000, embed_dim: int = 128,
 def transformer_classifier(vocab_size: int = 20000, dim: int = 128,
                            num_heads: int = 4, num_blocks: int = 2,
                            seq_len: int = 200, num_classes: int = 2,
-                           ff_mult: int = 4) -> Model:
+                           ff_mult: int = 4,
+                           moe_experts: int = 0) -> Model:
     """Pre-LN transformer encoder classifier — the long-context model
     family the reference never had (its sequence ceiling was one worker's
     LSTM, SURVEY.md §5.7).  Attention lowers to
     ``ops.attention.MultiHeadAttention``; for sequences sharded over an
     ``sp`` mesh axis the same math runs as ring attention
-    (``parallel.ring``)."""
+    (``parallel.ring``).
+
+    ``moe_experts > 0`` swaps the dense FF block for a switch-MoE FF
+    (``ops.moe.MoEDense`` — per-token top-1 routing; expert-sharded
+    execution over an ``ep`` mesh via ``switch_moe_sharded``)."""
     from ..ops.attention import (GlobalAvgPool1D, LayerNorm,
                                  MultiHeadAttention)
     layers = [Embedding(vocab_size, dim)]
     for _ in range(num_blocks):
         layers.append(Residual(Sequential([
             LayerNorm(), MultiHeadAttention(num_heads)])))
-        layers.append(Residual(Sequential([
-            LayerNorm(), Dense(dim * ff_mult, "gelu"), Dense(dim)])))
+        if moe_experts:
+            from ..ops.moe import MoEDense
+            ff: list = [MoEDense(moe_experts, d_hidden=dim * ff_mult)]
+        else:
+            ff = [Dense(dim * ff_mult, "gelu"), Dense(dim)]
+        layers.append(Residual(Sequential([LayerNorm(), *ff])))
     layers += [LayerNorm(), GlobalAvgPool1D(),
                Dense(num_classes, "softmax")]
     return Model(Sequential(layers), input_shape=(seq_len,),

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import math
 from functools import partial
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -124,6 +124,23 @@ def switch_moe(params: Tree, x, *, axis_name: str = "ep",
     return out, aux
 
 
+def dense_moe(params: Tree, x):
+    """Single-device reference formula: every token through its top-1
+    expert, no capacity limit (nothing to overflow without a dispatch
+    buffer).  Same math the sharded path computes for kept tokens."""
+    wg = params["router"]["wg"]
+    ex = params["experts"]
+    gates = jax.nn.softmax(x @ wg, axis=-1)
+    idx = jnp.argmax(gates, axis=-1)
+    gate = jnp.take_along_axis(gates, idx[:, None], 1)[:, 0]
+    h = jax.nn.relu(jnp.einsum("nd,edh->neh", x, ex["w1"]) + ex["b1"])
+    y = jnp.einsum("neh,ehd->ned", h, ex["w2"]) + ex["b2"]
+    picked = jnp.take_along_axis(y, idx[:, None, None], 1)[:, 0]
+    onehot = jax.nn.one_hot(idx, wg.shape[1], dtype=x.dtype)
+    aux = wg.shape[1] * jnp.sum(jnp.mean(onehot, 0) * jnp.mean(gates, 0))
+    return gate[:, None] * picked, aux
+
+
 def switch_moe_sharded(mesh: Mesh, params: Tree, x, *, axis: str = "ep",
                        capacity_factor: float = 1.25):
     """Whole-array entry point: tokens (N, d) sharded over ``mesh[axis]``,
@@ -150,3 +167,65 @@ def switch_moe_sharded(mesh: Mesh, params: Tree, x, *, axis: str = "ep",
         out_specs=(P(axis), P()),
         **_shard_map_kw())
     return fn(params, x)
+
+
+# ---------------------------------------------------------------------------
+# layer API integration (models.layers contract)
+# ---------------------------------------------------------------------------
+
+from ..models.layers import Layer, register  # noqa: E402
+
+
+@register
+class MoEDense(Layer):
+    """Switch-MoE feed-forward as a model layer: a drop-in for the
+    transformer FF block (wrap in ``Residual`` like any FF).
+
+    Runs the dense per-token formula (:func:`dense_moe`) — identical math
+    to the ``ep``-sharded path, single-program — unless a mesh is
+    attached (``layer.mesh = mesh``; find instances via
+    ``model.iter_layers()``), which switches execution to
+    :func:`switch_moe_sharded` over its ``ep`` axis.  The mesh is
+    runtime placement, not architecture, so it is deliberately NOT part
+    of the serialized config (a deserialized model runs dense until a
+    mesh is re-attached).
+
+    The mesh branch is TRACE-time state: attach it BEFORE any function
+    over the model is jitted.  An already-compiled executable (e.g.
+    ``ModelPredictor`` jits at construction) keeps its captured path —
+    re-jit (rebuild the predictor / trainer) after switching.
+
+    The router load-balance aux loss is written to ``state["aux_loss"]``
+    each step — surfaced for custom loops / monitoring; the stock
+    trainers optimize the task loss only (document-level choice: the
+    reference's trainers have no auxiliary-loss concept either).
+    """
+
+    def __init__(self, num_experts: int, d_hidden: Optional[int] = None,
+                 capacity_factor: float = 1.25):
+        self.num_experts = int(num_experts)
+        self.d_hidden = d_hidden if d_hidden is None else int(d_hidden)
+        self.capacity_factor = float(capacity_factor)
+        self.mesh: Optional[Mesh] = None  # runtime attachment, not config
+
+    def init(self, rng, in_shape):
+        d = in_shape[-1]
+        hidden = self.d_hidden if self.d_hidden is not None else 4 * d
+        seed = int(jax.random.randint(rng, (), 0,
+                                      jnp.iinfo(jnp.int32).max))
+        params = init_moe_params(seed, self.num_experts, d, hidden)
+        return params, {"aux_loss": jnp.zeros(())}, in_shape
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        tokens = x.reshape(-1, x.shape[-1])
+        if self.mesh is not None:
+            out, aux = switch_moe_sharded(
+                self.mesh, params, tokens,
+                capacity_factor=self.capacity_factor)
+        else:
+            out, aux = dense_moe(params, tokens)
+        return out.reshape(x.shape), {"aux_loss": aux.astype(jnp.float32)}
+
+    def get_config(self):
+        return {"num_experts": self.num_experts, "d_hidden": self.d_hidden,
+                "capacity_factor": self.capacity_factor}
