@@ -106,6 +106,13 @@ class KerasAdapter:
         return self.layer.apply(variables["params"], variables["state"], x,
                                 train=train, rng=rng)
 
+    def iter_layers(self):
+        """Model-protocol parity (``Model.iter_layers``).  An ingested
+        Keras graph has no native-layer internals to traverse — callers
+        get the shim only (no MoEDense/MultiHeadAttention instances to
+        configure; do that on the Keras side instead)."""
+        yield self.layer
+
     def predict_fn(self):
         def fn(variables, x):
             y, _ = self.apply(variables, x, train=False)

@@ -86,8 +86,12 @@ class MultiHeadAttention(Layer):
     backward, both O(T·D) HBM — the forward saves only O and the per-row
     logsumexp, dQ/dK/dV recompute scores blockwise).  Flash scales a
     single chip to HBM-limited sequence lengths for training and
-    inference; past one chip, the sequence-parallel ring path
-    (``parallel.ring``) shards T across devices.
+    inference; past one chip, attach a mesh (``layer.mesh = mesh``, find
+    instances via ``model.iter_layers()``) to run the sequence-parallel
+    ring path (``parallel.ring``): T shards over ``layer.ring_axis`` and
+    K/V rotate via ppermute.  Like ``MoEDense.mesh`` this is TRACE-time
+    runtime placement: attach before jitting, and it is not part of the
+    serialized config.
     """
 
     def __init__(self, num_heads: int, causal: bool = False,
@@ -97,6 +101,8 @@ class MultiHeadAttention(Layer):
         self.num_heads = int(num_heads)
         self.causal = bool(causal)
         self.impl = impl
+        self.mesh = None        # runtime attachment → ring attention
+        self.ring_axis = "sp"
 
     def init(self, rng, in_shape):
         t, d = in_shape
@@ -119,7 +125,12 @@ class MultiHeadAttention(Layer):
         q = q.reshape(b, t, h, dh)
         k = k.reshape(b, t, h, dh)
         v = v.reshape(b, t, h, dh)
-        if self.impl == "flash":
+        if self.mesh is not None:
+            from ..parallel.ring import ring_attention_sharded
+            o = ring_attention_sharded(self.mesh, q, k, v,
+                                       axis=self.ring_axis,
+                                       causal=self.causal)
+        elif self.impl == "flash":
             o = _flash_with_blocking(q, k, v, self.causal, t)
         else:
             o = dot_product_attention(q, k, v, causal=self.causal)
