@@ -1,6 +1,8 @@
 """MFU measurement for the headline workload (SURVEY.md §6 north star).
 
-Computes Model FLOPs Utilization for the ResNet-20/CIFAR-10 epoch program:
+Computes Model FLOPs Utilization for a zoo-model epoch program
+(ResNet-20/CIFAR-10 by default; ``--model resnet50`` for the
+ImageNet-subset config):
 
     MFU = (XLA-counted FLOPs per epoch / measured epoch seconds) / chip peak
 
@@ -10,7 +12,12 @@ program the trainer runs, counted by the compiler, not an analytic guess.
 Timing uses the bench.py methodology (hard device->host readback fence;
 ``block_until_ready`` returns at schedule time through the axon tunnel).
 
-Usage: ``python scripts/mfu.py [--batch 1024] [--width 16] [--steps 32]``
+Usage::
+
+    python scripts/mfu.py [--batch 1024] [--width 16] [--steps 32]
+    python scripts/mfu.py --model resnet50 --image-size 96 --classes 100 \
+        --batch 256
+
 Prints one JSON line; BASELINE.md records the numbers.
 """
 
@@ -39,10 +46,14 @@ PEAK_TFLOPS = {
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet20",
+                    choices=["resnet20", "resnet50"])
     ap.add_argument("--batch", type=int, default=1024)
     ap.add_argument("--steps", type=int, default=32)
     ap.add_argument("--width", type=int, default=16,
                     help="ResNet-20 base width (16 = the standard model)")
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--classes", type=int, default=10)
     ap.add_argument("--epochs", type=int, default=6,
                     help="timed epochs (after 2 warmup)")
     ap.add_argument("--peak-tflops", type=float, default=None)
@@ -62,12 +73,21 @@ def main():
 
     rng = np.random.default_rng(0)
     n = args.steps * args.batch
-    xs = rng.random((n, 32, 32, 3), dtype=np.float32)
-    ys = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=n)]
+    s, k = args.image_size, args.classes
+    if args.model == "resnet20":
+        model = zoo.resnet20(num_classes=k, width=args.width)
+        label = f"resnet20(width={args.width})"
+    else:
+        if args.width != 16:
+            ap.error("--width applies to resnet20 only")
+        model = zoo.resnet50(num_classes=k, input_size=s)
+        label = f"resnet50({s}px)"
+    xs = rng.random((n, s, s, 3), dtype=np.float32)
+    ys = np.eye(k, dtype=np.float32)[rng.integers(0, k, size=n)]
 
     warmup = 2
     trainer = SingleTrainer(
-        zoo.resnet20(width=args.width), "sgd", "categorical_crossentropy",
+        model, "sgd", "categorical_crossentropy",
         num_epoch=warmup + args.epochs, batch_size=args.batch,
         learning_rate=0.1, compute_dtype=args.dtype)
     run, optimizer = trainer._window_run()
@@ -75,8 +95,8 @@ def main():
     variables = trainer.model.init(0)
     opt_state = optimizer.init(variables["params"])
     key = jax.random.PRNGKey(1)
-    sx = jnp.asarray(xs.reshape(args.steps, args.batch, 32, 32, 3))
-    sy = jnp.asarray(ys.reshape(args.steps, args.batch, 10))
+    sx = jnp.asarray(xs.reshape(args.steps, args.batch, s, s, 3))
+    sy = jnp.asarray(ys.reshape(args.steps, args.batch, k))
 
     # compiler-counted FLOPs (fwd+bwd+opt).  XLA's HloCostAnalysis counts
     # a while/scan BODY once and does not multiply by trip count (verified
@@ -99,7 +119,7 @@ def main():
 
     achieved = epoch_flops / dt
     print(json.dumps({
-        "model": f"resnet20(width={args.width})",
+        "model": label,
         "batch": args.batch, "steps_per_epoch": args.steps,
         "compute_dtype": args.dtype, "device_kind": kind,
         "epoch_flops": epoch_flops,
