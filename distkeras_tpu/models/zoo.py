@@ -193,6 +193,38 @@ def transformer_classifier(vocab_size: int = 20000, dim: int = 128,
                  name="transformer_classifier")
 
 
+def gpt_lm(vocab_size: int = 256, dim: int = 128, num_heads: int = 4,
+           num_blocks: int = 2, seq_len: int = 256, ff_mult: int = 4,
+           attention_impl: str = "dense") -> Model:
+    """Decoder-only causal language model (GPT-style) — the canonical
+    long-context workload, beyond the reference's LSTM ceiling
+    (SURVEY.md §5.7).
+
+    Pre-LN blocks of causal ``MultiHeadAttention`` + gelu FF; ends in a
+    vocab-logits Dense (no softmax — pair with
+    ``loss='sparse_categorical_crossentropy'``, which averages per-token).
+    Targets are the input sequence shifted left by one.
+
+    ``attention_impl='flash'`` lowers attention to the Pallas
+    VMEM-resident kernels (O(T·D) HBM fwd+bwd); for sequences past one
+    chip, attach an ``sp`` mesh to every ``MultiHeadAttention`` found via
+    ``model.iter_layers()`` (set ``layer.mesh = mesh``; see
+    ``examples/longcontext.py``) to run ring attention over the
+    sequence shards."""
+    from ..ops.attention import (LayerNorm, MultiHeadAttention,
+                                 PositionalEmbedding)
+    layers = [Embedding(vocab_size, dim), PositionalEmbedding(seq_len)]
+    for _ in range(num_blocks):
+        layers.append(Residual(Sequential([
+            LayerNorm(),
+            MultiHeadAttention(num_heads, causal=True,
+                               impl=attention_impl)])))
+        layers.append(Residual(Sequential([
+            LayerNorm(), Dense(dim * ff_mult, "gelu"), Dense(dim)])))
+    layers += [LayerNorm(), Dense(vocab_size)]
+    return Model(Sequential(layers), input_shape=(seq_len,), name="gpt_lm")
+
+
 ZOO = {
     "mlp_mnist": mlp_mnist,
     "convnet_mnist": convnet_mnist,
@@ -201,4 +233,5 @@ ZOO = {
     "resnet50": resnet50,
     "lstm_imdb": lstm_imdb,
     "transformer_classifier": transformer_classifier,
+    "gpt_lm": gpt_lm,
 }

@@ -19,7 +19,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ..models.layers import Layer, glorot_uniform, register
+from ..models.layers import Layer, glorot_uniform, register, uniform_scale
 
 
 def dot_product_attention(q, k, v, *, causal: bool = False):
@@ -160,6 +160,31 @@ class LayerNorm(Layer):
 
     def get_config(self):
         return {"epsilon": self.epsilon}
+
+
+@register
+class PositionalEmbedding(Layer):
+    """Learned absolute position embeddings added to token embeddings:
+    (T, D) -> (T, D).  The standard GPT-style position encoding; the
+    table is sized at construction so shapes stay static under jit."""
+
+    def __init__(self, max_len: int):
+        self.max_len = int(max_len)
+
+    def init(self, rng, in_shape):
+        t, d = in_shape
+        if t > self.max_len:
+            raise ValueError(f"sequence length {t} exceeds "
+                             f"max_len={self.max_len}")
+        params = {"table": uniform_scale(rng, (self.max_len, d))}
+        return params, {}, in_shape
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        t = x.shape[1]
+        return x + params["table"][:t].astype(x.dtype), state
+
+    def get_config(self):
+        return {"max_len": self.max_len}
 
 
 @register

@@ -29,10 +29,11 @@ def categorical_crossentropy(logits, targets):
 
 
 def sparse_categorical_crossentropy(logits, targets):
-    """targets: int class ids (batch,)."""
+    """targets: int class ids, any shape matching logits' leading dims —
+    (batch,) for classifiers, (batch, seq) for per-token LM loss."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     return -jnp.mean(jnp.take_along_axis(
-        logp, targets.astype(jnp.int32)[:, None], axis=-1))
+        logp, targets.astype(jnp.int32)[..., None], axis=-1))
 
 
 def binary_crossentropy(logits, targets):
@@ -85,7 +86,7 @@ def sparse_categorical_crossentropy_from_probs(probs, targets):
     p = jnp.clip(probs, _EPS, 1.0)
     logp = jnp.log(p)
     return -jnp.mean(jnp.take_along_axis(
-        logp, targets.astype(jnp.int32)[:, None], axis=-1))
+        logp, targets.astype(jnp.int32)[..., None], axis=-1))
 
 
 def binary_crossentropy_from_probs(probs, targets):
