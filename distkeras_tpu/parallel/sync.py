@@ -116,15 +116,27 @@ def make_local_step(model, loss_fn: Callable,
     if remat:
         forward = jax.checkpoint(forward)
 
+    def cast_floats(tree):
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(compute_dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+
     def step(carry, batch):
         variables, opt_state, rng = carry
         x, y = batch
-        if compute_dtype is not None:
+        if compute_dtype is not None and \
+                jnp.issubdtype(x.dtype, jnp.floating):
             x = x.astype(compute_dtype)
         rng, sub = jax.random.split(rng)
 
         def loss_of(params):
-            out, new_state = forward(params, variables["state"], x, sub)
+            # mixed precision: master params stay f32 in the optimizer;
+            # the forward sees compute_dtype copies (covers token-input
+            # models too, where no float x exists to derive dtype from —
+            # layers cast their weights to the activation dtype)
+            fwd_params = cast_floats(params) if compute_dtype is not None \
+                else params
+            out, new_state = forward(fwd_params, variables["state"], x, sub)
             return loss_fn(out, y), new_state
 
         (loss_val, new_state), grads = jax.value_and_grad(

@@ -44,10 +44,9 @@ DIM = int(os.environ.get("DK_LM_DIM", 128))
 
 def corpus(n=ROWS, seq=SEQ, vocab=VOCAB, seed=0):
     """Next token = (current + 1) mod vocab; targets = inputs shifted."""
-    start = np.random.default_rng(seed).integers(0, vocab, size=n)
-    seqs = (start[:, None] + np.arange(seq + 1)) % vocab
-    return dk.Dataset({"features": seqs[:, :-1].astype(np.int32),
-                       "label": seqs[:, 1:].astype(np.int64)})
+    from distkeras_tpu.data.datasets import load_lm_corpus
+    return load_lm_corpus(n_train=n, seq_len=seq, vocab_size=vocab,
+                          seed=seed)[0]
 
 
 def token_accuracy(model, ds):

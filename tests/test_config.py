@@ -15,10 +15,11 @@ BENCH_YAML = os.path.join(ROOT, "configs", "bench_all.yaml")
 
 def test_bench_yaml_loads_all_configs():
     cfgs = cfg_mod.load_file(BENCH_YAML)
-    assert len(cfgs) == 6  # five BASELINE configs + streaming variant of #5
+    # five BASELINE configs + LM config + streaming variant of #5
+    assert len(cfgs) == 7
     assert [c.trainer for c in cfgs] == [
         "SingleTrainer", "ADAG", "DOWNPOUR", "AEASGD", "DynSGD",
-        "SingleTrainer"]
+        "SingleTrainer", "SingleTrainer"]
     # every config builds a real trainer of the right class with the right
     # hyperparameters (quick variant keeps data small)
     c = cfgs[1].with_quick()

@@ -20,13 +20,11 @@ VOCAB, SEQ = 17, 32
 
 
 def lm_problem(n=512, seq=SEQ, vocab=VOCAB, seed=0):
-    """Counting corpus: token t+1 = (token t + 1) mod vocab.  The next
-    token is a function of the current one alone, so a causal LM should
-    drive per-token accuracy to ~1.0 quickly."""
-    start = np.random.default_rng(seed).integers(0, vocab, size=n)
-    seqs = (start[:, None] + np.arange(seq + 1)) % vocab
-    return dk.Dataset({"features": seqs[:, :-1].astype(np.int32),
-                       "label": seqs[:, 1:].astype(np.int64)})
+    """Counting corpus (token t+1 = token t + 1 mod vocab): the loader's
+    train split — the single source of truth for the construction."""
+    from distkeras_tpu.data.datasets import load_lm_corpus
+    return load_lm_corpus(n_train=n, seq_len=seq, vocab_size=vocab,
+                          seed=seed)[0]
 
 
 def small_lm(**kw):
@@ -138,6 +136,27 @@ def test_remat_bitwise_equivalent_training(lm_ds):
                     jax.tree_util.tree_leaves(outs[1])):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-6, rtol=1e-6)
+
+
+def test_gpt_lm_bf16_compute():
+    """compute_dtype='bfloat16' engages for token-input models (no float
+    x to derive a dtype from — params are cast instead; int token ids
+    must NOT be cast: bf16 can't represent ids above 256 exactly, so a
+    vocab of 300 makes any id-through-bf16 corruption fail the counting
+    task's accuracy floor)."""
+    big_vocab = 300
+    ds = lm_problem(n=1024, vocab=big_vocab)
+    t = dk.SingleTrainer(small_lm(vocab_size=big_vocab), "adam",
+                         "sparse_categorical_crossentropy",
+                         features_col="features", label_col="label",
+                         num_epoch=8, batch_size=64, learning_rate=3e-3,
+                         compute_dtype="bfloat16")
+    m = t.train(ds)
+    assert token_accuracy(m, ds) > 0.95
+    # master params stayed f32 (mixed precision, not a weight cast)
+    assert all(np.asarray(p).dtype == np.float32
+               for p in jax.tree_util.tree_leaves(
+                   m.variables["params"]))
 
 
 def test_gpt_lm_serde_roundtrip(lm_ds):
