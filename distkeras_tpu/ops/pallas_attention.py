@@ -44,14 +44,25 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu" or not _HAS_PLTPU
 
 
-def _dot(a, b):  # f32 MXU matmul without input truncation
+def _dot(a, b):
+    """MXU matmul, f32 result.  Precision policy: f32 inputs use HIGHEST
+    (multi-pass, exact — the 2.4e-6-vs-f64 configuration BASELINE.md
+    records); sub-f32 inputs (bf16 training) run the MXU at full native
+    rate with f32 ACCUMULATION — the standard flash-attention trade, and
+    the same input precision XLA's dense path uses in bf16 training."""
+    if a.dtype == jnp.float32 and b.dtype == jnp.float32:
+        return lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                               precision=lax.Precision.HIGHEST)
     return lax.dot_general(a, b, (((1,), (0,)), ((), ())),
-                           precision=lax.Precision.HIGHEST)
+                           preferred_element_type=jnp.float32)
 
 
-def _dot_t(a, b):  # a @ b.T
+def _dot_t(a, b):  # a @ b.T, same precision policy as _dot
+    if a.dtype == jnp.float32 and b.dtype == jnp.float32:
+        return lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                               precision=lax.Precision.HIGHEST)
     return lax.dot_general(a, b, (((1,), (1,)), ((), ())),
-                           precision=lax.Precision.HIGHEST)
+                           preferred_element_type=jnp.float32)
 
 
 def _causal_mask(qi, kb, block_q, block_k, shape):
@@ -79,8 +90,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_acc, m_acc, l_acc, *,
         l_acc[:] = jnp.zeros_like(l_acc)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        s = _dot_t(q, k_ref[0].astype(jnp.float32))
+        # matmuls in the input dtype (f32 → HIGHEST, bf16 → full MXU
+        # rate with f32 accumulation); softmax statistics always f32
+        s = _dot_t(q_ref[0], k_ref[0]) * scale
         if causal:
             mask = _causal_mask(qi, kb, block_q, block_k, s.shape)
             s = jnp.where(mask, s, _NEG)
@@ -92,7 +104,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_acc, m_acc, l_acc, *,
         corr = jnp.exp(m_prev - m_new)
         l_acc[:, 0] = l_acc[:, 0] * corr + jnp.sum(p, axis=-1)
         o_acc[:] = o_acc[:] * corr[:, None] + _dot(
-            p, v_ref[0].astype(jnp.float32))
+            p.astype(v_ref.dtype), v_ref[0])
         m_acc[:, 0] = m_new
 
     if causal:
@@ -155,18 +167,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref, dq_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        s = _dot_t(q, k) * scale
+        s = _dot_t(q_ref[0], k_ref[0]) * scale
         p = jnp.exp(s - lse_ref[0, 0][:, None])
         if causal:
             mask = _causal_mask(qi, kb, block_q, block_k, s.shape)
             p = jnp.where(mask, p, 0.0)
-        dp = _dot_t(do, v)
+        dp = _dot_t(do_ref[0], v_ref[0])
         ds = p * (dp - dvec_ref[0, 0][:, None]) * scale
-        dq_acc[:] = dq_acc[:] + _dot(ds, k)
+        dq_acc[:] = dq_acc[:] + _dot(ds.astype(k_ref.dtype), k_ref[0])
 
     if causal:
         pl.when(kb * block_k <= qi * block_q + block_q - 1)(_compute)
@@ -191,20 +199,16 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dvec_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     def _compute():
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        s = _dot_t(q, k) * scale                      # (BQ, BK)
+        s = _dot_t(q_ref[0], k_ref[0]) * scale        # (BQ, BK)
         p = jnp.exp(s - lse_ref[0, 0][:, None])
         if causal:
             mask = _causal_mask(qj, kb, block_q, block_k, s.shape)
             p = jnp.where(mask, p, 0.0)
         # dV += P^T dO ; dS = P∘(dO V^T − D) ; dK += dS^T Q
-        dv_acc[:] = dv_acc[:] + _dot(p.T, do)
-        dp = _dot_t(do, v)
+        dv_acc[:] = dv_acc[:] + _dot(p.T.astype(do_ref.dtype), do_ref[0])
+        dp = _dot_t(do_ref[0], v_ref[0])
         ds = p * (dp - dvec_ref[0, 0][:, None]) * scale
-        dk_acc[:] = dk_acc[:] + _dot(ds.T, q)
+        dk_acc[:] = dk_acc[:] + _dot(ds.T.astype(q_ref.dtype), q_ref[0])
 
     if causal:
         # skip q blocks entirely ABOVE this k block's diagonal
@@ -294,6 +298,9 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
     Numerically equal to ``dot_product_attention`` (tested, gradients
     included); O(T·D) HBM traffic on BOTH forward and backward (the
     backward kernels recompute P blockwise from the saved logsumexp).
+    Precision follows the input dtype (see ``_dot``): f32 inputs are
+    exact (multi-pass HIGHEST); bf16 inputs run the MXU at full rate
+    with f32 accumulation and f32 online-softmax statistics.
     Interpret mode is selected automatically off TPU.
     """
     out, _ = _vjp_fwd(q, k, v, causal, block_q, block_k)
@@ -317,9 +324,10 @@ def _vjp_bwd(causal, block_q, block_k, res, g):
     b, t, h, dh = q.shape
     bq, bk = _blocks(t, block_q, block_k)
     scale = 1.0 / math.sqrt(dh)
-    do = _to_bh(g.astype(jnp.float32))
-    # D_i = rowsum(dO_i ∘ O_i) — the softmax-grad correction term
-    dvec = jnp.sum(do * out_bh.astype(jnp.float32), axis=-1)[:, None, :]
+    do = _to_bh(g.astype(q.dtype))
+    # D_i = rowsum(dO_i ∘ O_i) — the softmax-grad correction term (f32)
+    dvec = jnp.sum(do.astype(jnp.float32) * out_bh.astype(jnp.float32),
+                   axis=-1)[:, None, :]
     dq, dk, dv = _flash_bwd_raw(_to_bh(q), _to_bh(k), _to_bh(v), do, lse,
                                 dvec, causal=causal, bq=bq, bk=bk,
                                 scale=scale)

@@ -87,3 +87,26 @@ def test_flash_awkward_length_noncausal_raises():
     q, k, v = qkv(b=1, t=257, h=2, dh=16)
     with pytest.raises(ValueError, match="block-sized divisor"):
         _flash_with_blocking(q, k, v, False, 257)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bf16_path(causal):
+    """bf16 inputs take the full-rate MXU path (f32 accumulation): output
+    and grads stay within bf16 tolerances of the f32 dense reference."""
+    q, k, v = qkv(t=64)
+    qb, kb, vb = (a.astype(jnp.bfloat16) for a in (q, k, v))
+    dense = dot_product_attention(q, k, v, causal=causal)
+    flash = flash_attention(qb, kb, vb, causal, 16, 16)
+    assert flash.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(flash, np.float32),
+                               np.asarray(dense), rtol=0.06, atol=0.06)
+
+    gf = jax.grad(lambda a, b, c: jnp.sum(
+        flash_attention(a, b, c, causal, 16, 16).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2))(qb, kb, vb)
+    gd = jax.grad(lambda a, b, c: jnp.sum(
+        dot_product_attention(a, b, c, causal=causal) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b), rtol=0.15, atol=0.15)
