@@ -160,6 +160,19 @@ def lstm_imdb(vocab_size: int = 20000, embed_dim: int = 128,
     ]), input_shape=(seq_len,), name="lstm_imdb")
 
 
+def _ff_block(dim: int, ff_mult: int, moe_experts: int):
+    """Transformer FF block: pre-LN residual around dense-gelu-dense, or a
+    switch-MoE FF when ``moe_experts > 0`` (shared by
+    ``transformer_classifier`` and ``gpt_lm``)."""
+    from ..ops.attention import LayerNorm
+    if moe_experts:
+        from ..ops.moe import MoEDense
+        ff: list = [MoEDense(moe_experts, d_hidden=dim * ff_mult)]
+    else:
+        ff = [Dense(dim * ff_mult, "gelu"), Dense(dim)]
+    return Residual(Sequential([LayerNorm(), *ff]))
+
+
 def transformer_classifier(vocab_size: int = 20000, dim: int = 128,
                            num_heads: int = 4, num_blocks: int = 2,
                            seq_len: int = 200, num_classes: int = 2,
@@ -181,12 +194,7 @@ def transformer_classifier(vocab_size: int = 20000, dim: int = 128,
     for _ in range(num_blocks):
         layers.append(Residual(Sequential([
             LayerNorm(), MultiHeadAttention(num_heads)])))
-        if moe_experts:
-            from ..ops.moe import MoEDense
-            ff: list = [MoEDense(moe_experts, d_hidden=dim * ff_mult)]
-        else:
-            ff = [Dense(dim * ff_mult, "gelu"), Dense(dim)]
-        layers.append(Residual(Sequential([LayerNorm(), *ff])))
+        layers.append(_ff_block(dim, ff_mult, moe_experts))
     layers += [LayerNorm(), GlobalAvgPool1D(),
                Dense(num_classes, "softmax")]
     return Model(Sequential(layers), input_shape=(seq_len,),
@@ -195,7 +203,7 @@ def transformer_classifier(vocab_size: int = 20000, dim: int = 128,
 
 def gpt_lm(vocab_size: int = 256, dim: int = 128, num_heads: int = 4,
            num_blocks: int = 2, seq_len: int = 256, ff_mult: int = 4,
-           attention_impl: str = "dense") -> Model:
+           attention_impl: str = "dense", moe_experts: int = 0) -> Model:
     """Decoder-only causal language model (GPT-style) — the canonical
     long-context workload, beyond the reference's LSTM ceiling
     (SURVEY.md §5.7).
@@ -210,7 +218,11 @@ def gpt_lm(vocab_size: int = 256, dim: int = 128, num_heads: int = 4,
     chip, attach an ``sp`` mesh to every ``MultiHeadAttention`` found via
     ``model.iter_layers()`` (set ``layer.mesh = mesh``; see
     ``examples/longcontext.py``) to run ring attention over the
-    sequence shards."""
+    sequence shards.
+
+    ``moe_experts > 0`` swaps each dense FF block for a switch-MoE FF
+    (``ops.moe.MoEDense``) — same option as
+    ``transformer_classifier``."""
     from ..ops.attention import (LayerNorm, MultiHeadAttention,
                                  PositionalEmbedding)
     layers = [Embedding(vocab_size, dim), PositionalEmbedding(seq_len)]
@@ -219,8 +231,7 @@ def gpt_lm(vocab_size: int = 256, dim: int = 128, num_heads: int = 4,
             LayerNorm(),
             MultiHeadAttention(num_heads, causal=True,
                                impl=attention_impl)])))
-        layers.append(Residual(Sequential([
-            LayerNorm(), Dense(dim * ff_mult, "gelu"), Dense(dim)])))
+        layers.append(_ff_block(dim, ff_mult, moe_experts))
     layers += [LayerNorm(), Dense(vocab_size)]
     return Model(Sequential(layers), input_shape=(seq_len,), name="gpt_lm")
 

@@ -103,6 +103,7 @@ class MultiHeadAttention(Layer):
         self.impl = impl
         self.mesh = None        # runtime attachment → ring attention
         self.ring_axis = "sp"
+        self.batch_axis = None  # optional dp axis for dp×sp composition
 
     def init(self, rng, in_shape):
         t, d = in_shape
@@ -129,6 +130,7 @@ class MultiHeadAttention(Layer):
             from ..parallel.ring import ring_attention_sharded
             o = ring_attention_sharded(self.mesh, q, k, v,
                                        axis=self.ring_axis,
+                                       batch_axis=self.batch_axis,
                                        causal=self.causal)
         elif self.impl == "flash":
             o = _flash_with_blocking(q, k, v, self.causal, t)

@@ -76,13 +76,19 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False):
 
 
 def ring_attention_sharded(mesh: Mesh, q, k, v, *, axis: str = "sp",
-                           causal: bool = False):
+                           batch_axis: str = None, causal: bool = False):
     """Whole-array entry point: shards q/k/v on the sequence (T) axis over
-    ``mesh[axis]`` and runs ring attention.  q/k/v: (B, T, H, Dh)."""
+    ``mesh[axis]`` and runs ring attention.  q/k/v: (B, T, H, Dh).
+
+    ``batch_axis`` additionally shards the batch dimension over another
+    mesh axis (dp×sp composition: each dp replica runs its own sequence
+    ring over its batch shard — the K/V rotation stays within the sp
+    axis, so rings never cross data-parallel replicas)."""
+    spec = P(batch_axis, axis)
     fn = shard_map(
         partial(ring_attention, axis_name=axis, causal=causal),
         mesh=mesh,
-        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
-        out_specs=P(None, axis),
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
         **_shard_map_kw())
     return fn(q, k, v)

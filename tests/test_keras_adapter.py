@@ -14,6 +14,17 @@ if keras.backend.backend() != "jax":
 from distkeras_tpu.models.keras_adapter import KerasAdapter  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _deterministic_keras():
+    """Keras layers initialize from Keras's GLOBAL rng; without seeding,
+    every build_keras_* model gets different initial weights per run and
+    the convergence-margin tests flake (observed: the ADAG margin test
+    failing in full-suite runs while passing alone).  Function-scoped so
+    each test's weights are invariant to selection/ordering (-k, xdist),
+    not just to what ran before the module."""
+    keras.utils.set_random_seed(0)
+
+
 def build_keras_mlp():
     m = keras.Sequential([
         keras.layers.Input((10,)),
@@ -150,7 +161,7 @@ def test_keras_conv_batchnorm_single(img_ds):
 def test_keras_conv_batchnorm_distributed(img_ds):
     model = build_keras_convbn()
     t = dk.ADAG(model, "sgd", num_workers=8, communication_window=2,
-                **{**COMMON, "num_epoch": 8, "learning_rate": 0.1})
+                **{**COMMON, "num_epoch": 14, "learning_rate": 0.1})
     m = t.train(img_ds)
     assert accuracy(m, img_ds) > 0.85
 
