@@ -26,9 +26,18 @@ class Evaluator:
 
 
 def _to_class_index(a: np.ndarray, threshold: float = 0.5) -> np.ndarray:
-    """Accept class indices, one-hot/probability vectors, or (for the
-    binary 1-column case) sigmoid probabilities thresholded at 0.5."""
+    """Accept class indices (any shape — (B,) classifiers or (B, T)
+    per-token LM targets), one-hot/probability vectors (argmaxed on the
+    last axis), or (for the binary 1-column case) sigmoid probabilities
+    thresholded at 0.5."""
     a = np.asarray(a)
+    if np.issubdtype(a.dtype, np.integer) or a.dtype == bool:
+        if a.ndim >= 2 and a.shape[-1] == 1:
+            a = a[..., 0]
+        if a.ndim >= 2 and a.shape[-1] > 1 and a.min() >= 0 \
+                and a.max() <= 1 and np.all(a.sum(axis=-1) == 1):
+            return np.argmax(a, axis=-1)  # integer one-hot rows
+        return a.astype(np.int64)         # class ids, (B,) or (B, T)
     if a.ndim >= 2 and a.shape[-1] > 1:
         return np.argmax(a, axis=-1)
     flat = a.reshape(a.shape[0])

@@ -76,6 +76,13 @@ def main():
         print(f"attention={impl:5s} remat={remat}: next-token acc "
               f"{acc:.3f}, {time.time() - t0:.1f}s")
 
+    # greedy generation from the last trained model: the continuation
+    # should follow the corpus rule (next = current + 1 mod vocab)
+    prompt = jnp.asarray(ds["features"][:2, :8])
+    out = dk.generate_tokens(m, m.variables, prompt, num_steps=12)
+    print(f"prompt {np.asarray(prompt[0, -4:]).tolist()} -> generated "
+          f"{np.asarray(out[0, 8:]).tolist()}")
+
     # -- 4. sequence-parallel: ring attention over an sp mesh --------------
     n_dev = len(jax.devices())
     if n_dev >= 2 and SEQ % n_dev == 0:
