@@ -3,16 +3,28 @@
 The reference has no generative models (its inference surface is
 ``ModelPredictor`` classification, reference ``distkeras/predictors.py``);
 this completes the long-context family with a TPU-idiomatic decode loop:
-one ``lax.scan`` over positions, static shapes throughout (the token
-buffer is the model's full ``seq_len``; each step recomputes the causal
-forward and samples at the current position).
+one ``lax.scan`` over positions, static shapes throughout.
 
-Full-context recompute keeps the loop correct for ANY causal model —
-dense, flash (Pallas), ring-sharded, MoE, or a Keras-adapted decoder —
-because it reuses the exact training forward instead of a separate
-cached-decode path.  Cost is O(steps · T²) attention; for the sequence
-lengths the zoo trains on one chip this is dominated by dispatch, and the
-whole generation is ONE compiled program.
+Two decode strategies, both ONE compiled program:
+
+* **KV-cached** (default when the model supports it): a batched prefill
+  (one full forward that also records every layer's K/V —
+  ``Layer.apply_prefill``) followed by per-token decode steps
+  (``Layer.apply_decode``; ``MultiHeadAttention`` appends this
+  position's K/V and attends a single query) — O(T·D) per generated
+  token, time-to-first-token = one forward.  Covers stacks of
+  time-pointwise layers (Dense, LayerNorm, Embedding, MoE FF) + causal
+  attention, dense or flash impl.
+* **Full-context recompute** (fallback, ``use_cache=False``): rerun the
+  training forward on the whole buffer each step — O(T²·D) per token
+  but correct for ANY causal model, because it reuses the exact training
+  forward.  Auto-selected for mesh-attached (ring-sharded) attention
+  (per-chip full-length caches would defeat the sharding) and for
+  hybrid stacks containing a time-mixing layer without its own decode
+  rule (``Layer.time_mixing``).
+
+With ``temperature > 0`` the two strategies consume PRNG splits in the
+same order, so a given seed yields the same continuation on either path.
 """
 
 from __future__ import annotations
@@ -21,19 +33,44 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .layers import Layer
+
+
+def _model_cache(model, batch):
+    """The model's decode-cache pytree, or None when the cached path is
+    unsupported: no ``init_cache`` protocol, a mesh-attached (sharded)
+    layer, a time-mixing layer without its own decode rule, or simply
+    nothing in the stack that caches."""
+    init = getattr(model.layer, "init_cache", None)
+    if init is None:
+        return None
+    for lyr in model.iter_layers():
+        if getattr(lyr, "mesh", None) is not None:
+            return None
+        if getattr(lyr, "time_mixing", False) and \
+                type(lyr).apply_decode is Layer.apply_decode:
+            return None
+    cache = init(batch, model.input_shape)
+    # None leaves vanish from pytrees: empty => nothing in the stack caches
+    return cache if jax.tree_util.tree_leaves(cache) else None
+
 
 def generate_tokens(model, variables, prompt, num_steps: int,
-                    temperature: float = 0.0, seed: int = 0):
+                    temperature: float = 0.0, seed: int = 0,
+                    use_cache=None):
     """Generate ``num_steps`` tokens after ``prompt``.
 
     model: a causal LM whose ``apply(variables, x)`` maps (B, T) int
     tokens → (B, T, V) logits, T = ``model.input_shape[0]``.
     prompt: (B, P) int array, 1 <= P, P + num_steps <= T.
     temperature: 0.0 → greedy argmax; > 0 → categorical sampling.
+    use_cache: None → auto (KV-cached when the model supports it);
+    True forces the cached path (raises if unsupported); False forces
+    full-context recompute.
 
     Returns (B, P + num_steps) int32 — prompt + continuation.  The whole
-    loop is jit-compiled (scan over positions, dynamic position indexing
-    via one-hot contractions — no gather/scatter shape surprises on TPU).
+    loop is jit-compiled (scan over positions, one-hot position
+    read/write — no gather/scatter shape surprises on TPU).
     """
     t = int(model.input_shape[0])
     prompt = jnp.asarray(prompt, jnp.int32)
@@ -42,43 +79,83 @@ def generate_tokens(model, variables, prompt, num_steps: int,
         raise ValueError(f"prompt length {p} + {num_steps} steps exceeds "
                          f"the model's seq_len {t}")
 
+    cache = _model_cache(model, b) if use_cache in (None, True) else None
+    if use_cache is True and cache is None:
+        raise ValueError(
+            "use_cache=True but the cached decode path is unsupported "
+            "here: the model has no caching layer / init_cache protocol, "
+            "a mesh-attached (ring-sharded) attention layer, or a "
+            "time-mixing layer without a decode rule; use "
+            "use_cache=False (full-context recompute)")
+
     buf = jnp.zeros((b, t), jnp.int32).at[:, :p].set(prompt)
 
     # compiled runners are cached ON the model, keyed by everything the
     # closure bakes in — repeated generate_tokens calls (eval loops,
     # different seeds) reuse one compiled scan instead of retracing
-    key = (p, int(num_steps), float(temperature))
-    cache = getattr(model, "_generate_cache", None)
-    if cache is None:
-        cache = model._generate_cache = {}
-    run = cache.get(key)
+    key = (p, int(num_steps), float(temperature), cache is not None, b)
+    runners = getattr(model, "_generate_cache", None)
+    if runners is None:
+        runners = model._generate_cache = {}
+    run = runners.get(key)
+
     if run is None:
-        def _run(variables, buf, rng):
-            def step(carry, i):
-                buf, rng = carry
-                logits, _ = model.apply(variables, buf, train=False)
-                # logits at position p-1+i (the last valid token) via
-                # one-hot contraction: TPU-friendly dynamic indexing
-                pos = p - 1 + i
-                sel = jax.nn.one_hot(pos, t, dtype=logits.dtype)
-                next_logits = jnp.einsum("btv,t->bv", logits, sel)
-                if temperature > 0.0:
-                    rng, sub = jax.random.split(rng)
-                    nxt = jax.random.categorical(
-                        sub, next_logits / temperature, axis=-1)
-                else:
-                    nxt = jnp.argmax(next_logits, axis=-1)
-                # write the sampled token at position pos+1
-                write = jax.nn.one_hot(pos + 1, t, dtype=jnp.int32)
-                buf = buf * (1 - write)[None, :] \
-                    + nxt[:, None] * write[None, :]
-                return (buf, rng), nxt
+        def sample(next_logits, rng):
+            if temperature > 0.0:
+                rng, sub = jax.random.split(rng)
+                nxt = jax.random.categorical(
+                    sub, next_logits / temperature, axis=-1)
+            else:
+                nxt = jnp.argmax(next_logits, axis=-1)
+            return nxt.astype(jnp.int32), rng
 
-            (buf, _), _ = lax.scan(step, (buf, rng),
-                                   jnp.arange(num_steps))
-            return buf
+        def write_after(buf, nxt, pos):
+            """Write ``nxt`` into buf[:, pos+1] (one-hot update)."""
+            w = jax.nn.one_hot(pos + 1, t, dtype=jnp.int32)
+            return buf * (1 - w)[None, :] + nxt[:, None] * w[None, :]
 
-        run = cache[key] = jax.jit(_run)
+        if cache is not None:
+            def _run(variables, buf, cache, rng):
+                params, state = variables["params"], variables["state"]
+                # batched prefill: one forward fills every layer's cache
+                # (entries past the prompt are masked placeholders,
+                # overwritten as decoding advances)
+                y, cache = model.layer.apply_prefill(params, state, buf,
+                                                     cache)
+                logits0 = y[:, p - 1]
 
-    out = run(variables, buf, jax.random.PRNGKey(seed))
+                def step(carry, i):
+                    buf, cache, rng, logits_prev = carry
+                    nxt, rng = sample(logits_prev, rng)
+                    pos = p - 1 + i
+                    buf = write_after(buf, nxt, pos)
+                    logits_t, cache = model.layer.apply_decode(
+                        params, state, nxt, cache, pos + 1)
+                    return (buf, cache, rng, logits_t), None
+
+                # num_steps-1 decode forwards (logits0 covers the first
+                # token); the last token needs only a sample + write
+                (buf, _, rng, logits_prev), _ = lax.scan(
+                    step, (buf, cache, rng, logits0),
+                    jnp.arange(num_steps - 1))
+                last, _ = sample(logits_prev, rng)
+                return write_after(buf, last, p - 2 + num_steps)
+        else:
+            def _run(variables, buf, cache, rng):
+                def step(carry, i):
+                    buf, rng = carry
+                    logits, _ = model.apply(variables, buf, train=False)
+                    pos = p - 1 + i
+                    sel = jax.nn.one_hot(pos, t, dtype=logits.dtype)
+                    next_logits = jnp.einsum("btv,t->bv", logits, sel)
+                    nxt, rng = sample(next_logits, rng)
+                    return (write_after(buf, nxt, pos), rng), None
+
+                (buf, _), _ = lax.scan(step, (buf, rng),
+                                       jnp.arange(num_steps))
+                return buf
+
+        run = runners[key] = jax.jit(_run)
+
+    out = run(variables, buf, cache, jax.random.PRNGKey(seed))
     return out[:, :p + num_steps]

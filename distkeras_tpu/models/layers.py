@@ -121,6 +121,41 @@ class Layer:
     def apply(self, params, state, x, *, train: bool = False, rng=None):
         raise NotImplementedError
 
+    # -- cached autoregressive decode (causal LMs) --------------------------
+
+    #: layers that mix information ACROSS the time axis set this True;
+    #: the decode protocol refuses stacks containing a time-mixing layer
+    #: without its own apply_decode override (the pointwise default would
+    #: silently compute the wrong thing on per-token input)
+    time_mixing = False
+
+    def init_cache(self, batch: int, in_shape: tuple):
+        """Decode-cache pytree for one-position-at-a-time generation
+        (``models.generation``), or None for cache-free layers.
+        ``in_shape`` is the layer's input shape INCLUDING the time axis
+        (same walk as ``init``); the time extent bounds the cache."""
+        return None
+
+    def apply_decode(self, params, state, x, cache, pos):
+        """One-token decode step: ``x`` is (B, ...) for position ``pos``
+        (no time axis) → ``(y, cache)``.  Default covers time-pointwise
+        layers (Dense, LayerNorm, Embedding, activations, MoE FF — their
+        ``apply`` treats the time axis elementwise, so per-token input is
+        just a batch); time-MIXING layers must override (see
+        ``MultiHeadAttention``) — ``models.generation`` enforces this via
+        ``time_mixing`` and falls back to full-context recompute."""
+        y, _ = self.apply(params, state, x, train=False)
+        return y, cache
+
+    def apply_prefill(self, params, state, x, cache):
+        """Batched prefill: run the FULL-sequence forward (x has its time
+        axis) while filling the decode cache → ``(y, cache)``.  Default
+        (cache-free layers) is the ordinary inference apply; caching
+        layers override to also record K/V (one batched forward instead
+        of per-token prefill steps)."""
+        y, _ = self.apply(params, state, x, train=False)
+        return y, cache
+
     def iter_layers(self):
         """Yield this layer and every nested layer (depth-first through
         the composition attributes: ``layers``, ``inner``, ``shortcut``).
@@ -245,6 +280,7 @@ class Dropout(Layer):
 @register
 class Conv2D(Layer):
     """NHWC conv lowering to ``lax.conv_general_dilated`` (MXU-tiled by XLA)."""
+    time_mixing = True
 
     def __init__(self, filters: int, kernel_size, strides=1, padding="SAME",
                  activation=None, use_bias: bool = True):
@@ -302,6 +338,7 @@ class _Pool2D(Layer):
     ``shard_map`` (jax 0.9), which the distributed conv trainers hit —
     and XLA fuses the slices back into one windowed reduction.
     """
+    time_mixing = True
 
     def __init__(self, pool_size=2, strides=None, padding="VALID"):
         self.pool_size = (pool_size, pool_size) if isinstance(pool_size, int) else tuple(pool_size)
@@ -376,6 +413,7 @@ class AvgPool2D(_Pool2D):
 
 @register
 class GlobalAvgPool2D(Layer):
+    time_mixing = True
     def out_shape(self, in_shape):
         return (in_shape[-1],)
 
@@ -457,6 +495,7 @@ class LSTM(Layer):
     BASELINE.json).  Gates are fused into one (in+h, 4h) matmul so each scan
     step is a single MXU-shaped GEMM.
     """
+    time_mixing = True
 
     def __init__(self, units: int, return_sequences: bool = False):
         self.units = int(units)
@@ -561,6 +600,38 @@ class Residual(Layer):
             sc = x
         return self._act(y + sc), new_state
 
+    def init_cache(self, batch, in_shape):
+        cache = {"inner": self.inner.init_cache(batch, in_shape)}
+        if self.shortcut is not None:
+            cache["shortcut"] = self.shortcut.init_cache(batch, in_shape)
+        return cache
+
+    def apply_decode(self, params, state, x, cache, pos):
+        y, ci = self.inner.apply_decode(params["inner"], state["inner"],
+                                        x, cache["inner"], pos)
+        new_cache = {"inner": ci}
+        if self.shortcut is not None:
+            sc, cs = self.shortcut.apply_decode(
+                params["shortcut"], state["shortcut"], x,
+                cache["shortcut"], pos)
+            new_cache["shortcut"] = cs
+        else:
+            sc = x
+        return self._act(y + sc), new_cache
+
+    def apply_prefill(self, params, state, x, cache):
+        y, ci = self.inner.apply_prefill(params["inner"], state["inner"],
+                                         x, cache["inner"])
+        new_cache = {"inner": ci}
+        if self.shortcut is not None:
+            sc, cs = self.shortcut.apply_prefill(
+                params["shortcut"], state["shortcut"], x,
+                cache["shortcut"])
+            new_cache["shortcut"] = cs
+        else:
+            sc = x
+        return self._act(y + sc), new_cache
+
     def get_config(self):
         return {"inner": self.inner.config(),
                 "shortcut": self.shortcut.config() if self.shortcut else None,
@@ -614,6 +685,27 @@ class Sequential(Layer):
             x, s = lyr.apply(params[i], state[i], x, train=train, rng=sub)
             new_state.append(s)
         return x, new_state
+
+    def init_cache(self, batch, in_shape):
+        caches, shape = [], tuple(in_shape)
+        for lyr in self.layers:
+            caches.append(lyr.init_cache(batch, shape))
+            shape = lyr.out_shape(shape)
+        return caches
+
+    def apply_decode(self, params, state, x, cache, pos):
+        new_cache = []
+        for i, lyr in enumerate(self.layers):
+            x, c = lyr.apply_decode(params[i], state[i], x, cache[i], pos)
+            new_cache.append(c)
+        return x, new_cache
+
+    def apply_prefill(self, params, state, x, cache):
+        new_cache = []
+        for i, lyr in enumerate(self.layers):
+            x, c = lyr.apply_prefill(params[i], state[i], x, cache[i])
+            new_cache.append(c)
+        return x, new_cache
 
     def get_config(self):
         return {"layers": [l.config() for l in self.layers],

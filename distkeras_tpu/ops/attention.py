@@ -94,6 +94,8 @@ class MultiHeadAttention(Layer):
     serialized config.
     """
 
+    time_mixing = True  # has its own apply_decode/apply_prefill rules
+
     def __init__(self, num_heads: int, causal: bool = False,
                  impl: str = "dense"):
         if impl not in ("dense", "flash"):
@@ -138,6 +140,65 @@ class MultiHeadAttention(Layer):
             o = dot_product_attention(q, k, v, causal=self.causal)
         o = o.reshape(b, t, d)
         return o @ params["out"].astype(x.dtype), state
+
+    def init_cache(self, batch, in_shape):
+        t, d = in_shape
+        dh = d // self.num_heads
+        shape = (batch, t, self.num_heads, dh)
+        return {"k": jnp.zeros(shape), "v": jnp.zeros(shape)}
+
+    def apply_decode(self, params, state, x, cache, pos):
+        """One-token cached decode: append this position's K/V to the
+        cache, attend the single query over positions <= pos.  O(T·D)
+        per token vs the recompute path's O(T²·D).  Decoding is
+        inherently causal — only meaningful for ``causal=True`` layers."""
+        if not self.causal:
+            raise ValueError("cached decode requires causal=True attention")
+        b, d = x.shape
+        h = self.num_heads
+        dh = d // h
+        qkv = x @ params["qkv"].astype(x.dtype)           # (B, 3D)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        kc = jax.lax.dynamic_update_slice(
+            cache["k"], k.reshape(b, 1, h, dh).astype(cache["k"].dtype),
+            (0, pos, 0, 0))
+        vc = jax.lax.dynamic_update_slice(
+            cache["v"], v.reshape(b, 1, h, dh).astype(cache["v"].dtype),
+            (0, pos, 0, 0))
+        q = q.reshape(b, h, dh)
+        s = jnp.einsum("bhd,bthd->bht", q, kc,
+                       preferred_element_type=jnp.float32) / math.sqrt(dh)
+        t_idx = jnp.arange(kc.shape[1])
+        s = jnp.where(t_idx[None, None, :] <= pos, s, -1e30)
+        w = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bht,bthd->bhd", w,
+                       vc.astype(jnp.float32)).astype(x.dtype)
+        return o.reshape(b, d) @ params["out"].astype(x.dtype), \
+            {"k": kc, "v": vc}
+
+    def apply_prefill(self, params, state, x, cache):
+        """Batched prefill: one full causal forward over the buffer (via
+        the layer's own configured attention impl — dense or flash) that
+        also records every position's K/V into the cache.  Cache entries
+        past the prompt are placeholders: masked during decode and
+        overwritten position-by-position as tokens are generated."""
+        if not self.causal:
+            raise ValueError("cached decode requires causal=True attention")
+        b, t, d = x.shape
+        h = self.num_heads
+        dh = d // h
+        qkv = x @ params["qkv"].astype(x.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, h, dh)
+        k = k.reshape(b, t, h, dh)
+        v = v.reshape(b, t, h, dh)
+        if self.impl == "flash":
+            o = _flash_with_blocking(q, k, v, True, t)
+        else:
+            o = dot_product_attention(q, k, v, causal=True)
+        cache = {"k": k.astype(cache["k"].dtype),
+                 "v": v.astype(cache["v"].dtype)}
+        return o.reshape(b, t, d) @ params["out"].astype(x.dtype), cache
 
     def get_config(self):
         return {"num_heads": self.num_heads, "causal": self.causal,
@@ -185,6 +246,10 @@ class PositionalEmbedding(Layer):
         t = x.shape[1]
         return x + params["table"][:t].astype(x.dtype), state
 
+    def apply_decode(self, params, state, x, cache, pos):
+        row = jax.lax.dynamic_slice_in_dim(params["table"], pos, 1, 0)[0]
+        return x + row.astype(x.dtype), cache
+
     def get_config(self):
         return {"max_len": self.max_len}
 
@@ -192,6 +257,7 @@ class PositionalEmbedding(Layer):
 @register
 class GlobalAvgPool1D(Layer):
     """Mean over the time axis: (T, D) -> (D,)."""
+    time_mixing = True
 
     def out_shape(self, in_shape):
         return (in_shape[-1],)

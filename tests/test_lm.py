@@ -238,7 +238,9 @@ def test_gpt_lm_moe_trains(lm_ds):
 
 def test_generate_continues_the_count(lm_ds):
     """Train the LM, then greedy-generate: the continuation must follow
-    the counting rule exactly (the end-to-end train -> generate story)."""
+    the counting rule exactly (the end-to-end train -> generate story),
+    via BOTH decode strategies — KV-cached (default) and full-context
+    recompute — which must agree."""
     t = dk.SingleTrainer(small_lm(), "adam",
                          "sparse_categorical_crossentropy",
                          features_col="features", label_col="label",
@@ -251,6 +253,29 @@ def test_generate_continues_the_count(lm_ds):
                                   np.asarray(prompt))
     expected = (np.asarray(prompt[:, -1:]) + 1
                 + np.arange(16)[None, :]) % VOCAB
+    np.testing.assert_array_equal(np.asarray(out[:, 8:]), expected)
+    # the cached path actually engaged (gpt_lm stacks support it)...
+    from distkeras_tpu.models.generation import _model_cache
+    assert _model_cache(m, 4) is not None
+    # ...and the recompute fallback generates the identical continuation
+    out2 = dk.generate_tokens(m, m.variables, prompt, num_steps=16,
+                              use_cache=False)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+def test_generate_cached_moe(lm_ds):
+    """KV-cached decode through a MoE-FF stack (MoEDense's apply is
+    token-pointwise, so the default decode path covers it)."""
+    t = dk.SingleTrainer(small_lm(moe_experts=4), "adam",
+                         "sparse_categorical_crossentropy",
+                         features_col="features", label_col="label",
+                         num_epoch=8, batch_size=64, learning_rate=3e-3)
+    m = t.train(lm_ds)
+    prompt = jnp.asarray(lm_ds["features"][:2, :8])
+    out = dk.generate_tokens(m, m.variables, prompt, num_steps=8,
+                             use_cache=True)
+    expected = (np.asarray(prompt[:, -1:]) + 1 + np.arange(8)[None, :]) \
+        % VOCAB
     np.testing.assert_array_equal(np.asarray(out[:, 8:]), expected)
 
 
@@ -284,3 +309,64 @@ def test_lm_predictor_evaluator_path(lm_ds):
     acc = dk.AccuracyEvaluator("prediction", "label").evaluate(pred)
     assert acc > 0.95
     assert abs(acc - token_accuracy(m, lm_ds)) < 1e-6
+
+
+def test_generate_seed_parity_across_strategies(lm_ds):
+    """With temperature > 0, the cached and recompute paths consume PRNG
+    splits in the same order: one seed, same continuation either way."""
+    model = small_lm()
+    v = model.init(0)
+    prompt = jnp.asarray(lm_ds["features"][:2, :6])
+    a = dk.generate_tokens(model, v, prompt, 8, temperature=1.0, seed=3,
+                           use_cache=True)
+    b = dk.generate_tokens(model, v, prompt, 8, temperature=1.0, seed=3,
+                           use_cache=False)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_generate_ring_mesh_falls_back_to_recompute(lm_ds):
+    """A mesh-attached (ring-sharded) model must NOT take the cached path
+    (per-chip full-length caches would defeat the sharding): auto mode
+    falls back to recompute and still generates correctly; forcing
+    use_cache=True raises."""
+    from distkeras_tpu.models.generation import _model_cache
+    t = dk.SingleTrainer(small_lm(), "adam",
+                         "sparse_categorical_crossentropy",
+                         features_col="features", label_col="label",
+                         num_epoch=8, batch_size=64, learning_rate=3e-3)
+    m = t.train(lm_ds)
+    mesh = make_mesh(8, ("sp",))
+    for layer in m.iter_layers():
+        if isinstance(layer, MultiHeadAttention):
+            layer.mesh = mesh
+    try:
+        assert _model_cache(m, 2) is None
+        with pytest.raises(ValueError, match="unsupported"):
+            dk.generate_tokens(m, m.variables,
+                               jnp.asarray(lm_ds["features"][:2, :8]),
+                               4, use_cache=True)
+        out = dk.generate_tokens(m, m.variables,
+                                 jnp.asarray(lm_ds["features"][:2, :8]), 4)
+        expected = (np.asarray(lm_ds["features"][:2, 7:8]) + 1
+                    + np.arange(4)[None, :]) % VOCAB
+        np.testing.assert_array_equal(np.asarray(out[:, 8:]), expected)
+    finally:
+        for layer in m.iter_layers():
+            if isinstance(layer, MultiHeadAttention):
+                layer.mesh = None
+
+
+def test_generate_time_mixing_guard():
+    """An LSTM-bearing causal stack has no decode rule: auto mode must
+    not silently select the cached path."""
+    from distkeras_tpu.models.generation import _model_cache
+    from distkeras_tpu.models.layers import (Dense, Embedding, LSTM,
+                                             Sequential)
+    from distkeras_tpu.ops.attention import MultiHeadAttention as MHA
+    m = dk.Model(Sequential([
+        Embedding(VOCAB, 16),
+        MHA(2, causal=True),
+        LSTM(16),
+        Dense(VOCAB),
+    ]), input_shape=(SEQ,))
+    assert _model_cache(m, 2) is None
