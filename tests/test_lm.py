@@ -370,3 +370,16 @@ def test_generate_time_mixing_guard():
         Dense(VOCAB),
     ]), input_shape=(SEQ,))
     assert _model_cache(m, 2) is None
+
+
+def test_generate_cached_flash_impl(lm_ds):
+    """Cached generation through a flash-impl model (the prefill runs the
+    Pallas kernel, the decode steps the cached einsum): identical greedy
+    continuation to the dense-impl model on the same weights."""
+    dense = small_lm()
+    flash = small_lm(attention_impl="flash")
+    v = dense.init(0)
+    prompt = jnp.asarray(lm_ds["features"][:2, :8])
+    a = dk.generate_tokens(dense, v, prompt, 8, use_cache=True)
+    b = dk.generate_tokens(flash, v, prompt, 8, use_cache=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
