@@ -99,10 +99,6 @@ def build(cfg: RunConfig):
     import distkeras_tpu as dk
     from .data.transformers import OneHotTransformer
 
-    if cfg.streaming and cfg.trainer != "SingleTrainer":
-        raise ValueError(
-            f"streaming: requires trainer SingleTrainer (the only trainer "
-            f"that consumes a ShardedFileDataset), got {cfg.trainer!r}")
     model = getattr(dk.zoo, cfg.model)(**cfg.model_kwargs)
     train, test, _meta = getattr(dk.datasets, cfg.dataset)(
         **cfg.dataset_kwargs)
@@ -112,20 +108,6 @@ def build(cfg: RunConfig):
         test = enc.transform(test)
     test = test.take(int(cfg.test_take)) if cfg.test_take else None
 
-    if cfg.streaming:
-        import atexit
-        import shutil
-        import tempfile
-        from .data.streaming import ShardedFileDataset
-        rows = cfg.streaming if isinstance(cfg.streaming, int) \
-            and not isinstance(cfg.streaming, bool) else 4096
-        spill_dir = tempfile.mkdtemp(prefix="dk_stream_")
-        # the spill is run-scoped scratch, not a dataset the user keeps:
-        # run() removes it eagerly; atexit covers direct build() callers
-        atexit.register(shutil.rmtree, spill_dir, ignore_errors=True)
-        train = ShardedFileDataset.write(train, spill_dir,
-                                         rows_per_shard=rows)
-
     kw = {**_DEFAULT_TRAINER_KW, **cfg.trainer_kwargs}
     if kw.get("num_workers") == "auto":
         # as many workers as the machine has devices, capped at 8 (the
@@ -133,6 +115,27 @@ def build(cfg: RunConfig):
         # single chip and on an 8-device mesh alike
         import jax
         kw["num_workers"] = min(8, len(jax.devices()))
+
+    if cfg.streaming:
+        import atexit
+        import shutil
+        import tempfile
+        from .data.streaming import ShardedFileDataset
+        if isinstance(cfg.streaming, int) and \
+                not isinstance(cfg.streaming, bool):
+            rows = cfg.streaming
+        else:
+            # default shard size, capped so a distributed trainer gets at
+            # least one shard per worker (partition == worker)
+            nw = int(kw.get("num_workers") or 1)
+            rows = min(4096, max(1, train.num_rows // max(1, nw)))
+        spill_dir = tempfile.mkdtemp(prefix="dk_stream_")
+        # the spill is run-scoped scratch, not a dataset the user keeps:
+        # run() removes it eagerly; atexit covers direct build() callers
+        atexit.register(shutil.rmtree, spill_dir, ignore_errors=True)
+        train = ShardedFileDataset.write(train, spill_dir,
+                                         rows_per_shard=rows)
+
     trainer_cls = getattr(dk, cfg.trainer)
     return trainer_cls(model, **kw), train, test
 

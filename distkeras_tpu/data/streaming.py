@@ -48,6 +48,7 @@ class ShardedFileDataset:
         self.shards: list = meta["shards"]
         self.num_rows: int = int(meta["num_rows"])
         self.column_names: list = meta["columns"]
+        self._shard_rows: Optional[list] = meta.get("shard_rows")
         self._tf_spec_cache: dict = {}  # (cols, batch) -> TensorSpec tuple
 
     # -- construction -------------------------------------------------------
@@ -56,32 +57,98 @@ class ShardedFileDataset:
               rows_per_shard: int = 4096) -> "ShardedFileDataset":
         """Spill an in-memory ``Dataset`` to disk shards."""
         os.makedirs(directory, exist_ok=True)
-        shards = []
+        shards, shard_rows = [], []
         for i, lo in enumerate(range(0, dataset.num_rows, rows_per_shard)):
             hi = min(lo + rows_per_shard, dataset.num_rows)
             name = f"shard_{i:05d}.npz"
             np.savez(os.path.join(directory, name),
                      **{c: dataset[c][lo:hi] for c in dataset.column_names})
             shards.append(name)
+            shard_rows.append(hi - lo)
         with open(os.path.join(directory, _META), "w") as f:
             json.dump({"shards": shards, "num_rows": dataset.num_rows,
-                       "columns": dataset.column_names}, f)
+                       "columns": dataset.column_names,
+                       "shard_rows": shard_rows}, f)
         return ShardedFileDataset(directory)
 
     # -- iteration ----------------------------------------------------------
     def steps_per_epoch(self, batch_size: int) -> int:
         return self.num_rows // batch_size
 
+    def shard_rows(self) -> list:
+        """Per-shard row counts.  Written into ``meta.json`` by
+        :meth:`write`; for directories from other writers, probed once by
+        reading each shard's first ``.npy`` header (no array data)."""
+        if self._shard_rows is None:
+            import zipfile
+            col0 = self.column_names[0] + ".npy"
+            rows = []
+            for name in self.shards:
+                with zipfile.ZipFile(
+                        os.path.join(self.directory, name)) as z, \
+                        z.open(col0) as f:
+                    version = np.lib.format.read_magic(f)
+                    if version == (1, 0):
+                        shape, _, _ = np.lib.format.read_array_header_1_0(f)
+                    else:
+                        shape, _, _ = np.lib.format.read_array_header_2_0(f)
+                    rows.append(int(shape[0]))
+            self._shard_rows = rows
+        return self._shard_rows
+
+    # -- per-worker partitioning (Spark partition == worker; SURVEY.md §3.1
+    # boundary #1: each executor streams ITS files, never the whole set) ----
+    def worker_shard_indices(self, worker: int, num_workers: int) -> list:
+        """Round-robin shard → worker assignment (shard i → worker i % P).
+        With ``rows_per_shard = num_rows // P`` this reproduces the
+        in-memory ``Dataset.repartition(P)`` contiguous split exactly."""
+        if not (0 <= worker < num_workers):
+            raise ValueError(f"worker {worker} outside [0, {num_workers})")
+        if len(self.shards) < num_workers:
+            raise ValueError(
+                f"{len(self.shards)} shards cannot feed {num_workers} "
+                f"workers (need >= one shard per worker; re-write with "
+                f"rows_per_shard <= {self.num_rows // num_workers})")
+        return list(range(worker, len(self.shards), num_workers))
+
+    def worker_rows(self, worker: int, num_workers: int) -> int:
+        rows = self.shard_rows()
+        return sum(rows[i] for i in
+                   self.worker_shard_indices(worker, num_workers))
+
+    def worker_steps_per_epoch(self, batch_size: int,
+                               num_workers: int) -> int:
+        """Common per-worker step count: min over workers (static shapes —
+        every worker must run the same number of jit steps per epoch)."""
+        return min(self.worker_rows(k, num_workers) // batch_size
+                   for k in range(num_workers))
+
+    def worker_batches(self, cols: Sequence[str], batch_size: int,
+                       worker: int, num_workers: int,
+                       engine: str = "thread", prefetch: int = 4,
+                       seed: Optional[int] = None) -> Iterator[tuple]:
+        """Stream batches drawn only from ``worker``'s shard partition.
+        ``seed`` is decorrelated per worker (shard order + in-shard perm)."""
+        idx = self.worker_shard_indices(worker, num_workers)
+        wseed = None if seed is None else (seed * num_workers + worker + 1)
+        src = self._batch_source(cols, batch_size, wseed, shard_indices=idx)
+        if engine == "thread":
+            return _prefetched(src, prefetch)
+        return src
+
     def _load(self, name: str) -> dict:
         with np.load(os.path.join(self.directory, name)) as d:
             return {k: d[k] for k in d.files}
 
     def _batch_source(self, cols: Sequence[str], batch_size: int,
-                      seed: Optional[int]) -> Iterator[tuple]:
+                      seed: Optional[int],
+                      shard_indices: Optional[Sequence[int]] = None
+                      ) -> Iterator[tuple]:
         """Sequential batch generator: shard order (optionally shuffled per
         epoch), rows carried across shard boundaries, remainder dropped
         (static shapes — SURVEY.md §7 XLA recompilation trap)."""
-        order = list(range(len(self.shards)))
+        order = list(shard_indices) if shard_indices is not None \
+            else list(range(len(self.shards)))
         if seed is not None:
             np.random.default_rng(seed).shuffle(order)
         carry = None
@@ -131,6 +198,25 @@ class ShardedFileDataset:
         ds = tf.data.Dataset.from_generator(gen, output_signature=spec)
         ds = ds.prefetch(tf.data.AUTOTUNE)
         return ((tuple(t.numpy() for t in item)) for item in ds)
+
+
+def window_batches(it: Iterator[tuple], window: int) -> Iterator[tuple]:
+    """Group ``window`` consecutive batch tuples into one tuple of stacked
+    arrays with a leading ``(window,)`` axis — the host-side assembly of a
+    communication window (trainers feed these to one jit window program).
+    A trailing partial window is dropped (static shapes)."""
+    import itertools
+    try:
+        while True:
+            group = list(itertools.islice(it, window))
+            if len(group) < window:
+                return
+            yield tuple(np.stack(col) for col in zip(*group))
+    finally:
+        # deterministic teardown: a consumer that abandons the epoch early
+        # must release the source's prefetch thread/shard immediately
+        if hasattr(it, "close"):
+            it.close()
 
 
 def _has_tf() -> bool:

@@ -346,3 +346,37 @@ class SyncEngine:
 
         return run
 
+    # -- streaming window ---------------------------------------------------
+    def window_fn(self):
+        """jit-compiled SINGLE window: (center, local, opt_state, rngs, wx,
+        wy) -> EpochResult with losses (workers, window).
+
+        The disk-streaming trainers drive this once per communication
+        window (the host assembles window w+1 while the devices train
+        window w); the collective at the window edge is identical to the
+        epoch program's.  Model/opt state is donated — it updates in place
+        in HBM across the host loop.
+        """
+        axis = self.axis
+
+        def per_device(center, local, opt_state, rng, wx, wy):
+            local, opt_state, rng = (_squeeze0(local), _squeeze0(opt_state),
+                                     rng[0])
+            (local, opt_state, rng), losses = lax.scan(
+                self._local_step, (local, opt_state, rng), (wx[0], wy[0]))
+            center, local = self.algo.communicate(center, local, axis)
+            return (center, _expand0(local), _expand0(opt_state),
+                    rng[None], losses[None])
+
+        mapped = shard_map(
+            per_device, mesh=self.mesh,
+            in_specs=(P(), P(axis), P(axis), P(axis), P(axis), P(axis)),
+            out_specs=(P(), P(axis), P(axis), P(axis), P(axis)),
+            **_shard_map_kw())
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def run(center, local, opt_state, rngs, wx, wy):
+            return EpochResult(*mapped(center, local, opt_state, rngs, wx, wy))
+
+        return run
+

@@ -38,18 +38,60 @@ _WORKER_CLASSES = {
 }
 
 
-def run_async_training(trainer, dataset, fault_injector=None):
+class _StreamPlan:
+    """Per-worker disk-streaming data plan (async counterpart of
+    ``DistributedTrainer._train_sync_stream``): each worker iterates ITS
+    shard partition of a ``ShardedFileDataset``; nothing is staged in RAM."""
+
+    def __init__(self, trainer, source, shuffle: bool):
+        self.source = source
+        self.shuffle = bool(shuffle)
+        self.P = trainer.num_workers
+        self.bs = trainer.batch_size
+        self.w = trainer.communication_window
+        self.cols = [trainer.features_col, trainer.label_col]
+        self.base_seed = trainer.seed
+        steps = source.worker_steps_per_epoch(self.bs, self.P)
+        self.n_windows = steps // self.w
+        if self.n_windows == 0:
+            raise ValueError(
+                f"communication_window {self.w} exceeds the {steps} steps "
+                f"available per worker (decrease window/batch_size or add "
+                f"data)")
+
+    def factory(self, k: int):
+        from ..data.streaming import window_batches
+
+        def make(epoch: int):
+            seed = (self.base_seed + 1000 + epoch) if self.shuffle else None
+            return window_batches(
+                self.source.worker_batches(self.cols, self.bs, k, self.P,
+                                           seed=seed), self.w)
+        return make
+
+
+def run_async_training(trainer, dataset, fault_injector=None,
+                       stream_shuffle=None):
     """Drive async-PS training for a DistributedTrainer subclass.
 
     The trainer supplies: model/loss/optimizer, ``num_workers``,
     ``communication_window``, epochs, the PS class (``_ps_factory``), the
     worker flavor (``_async_mode``) and the worker placement
-    (``async_workers``: threads or processes).
+    (``async_workers``: threads or processes).  ``dataset`` may be a
+    disk-backed ``ShardedFileDataset`` — workers then stream their shard
+    partitions instead of receiving staged arrays.
     """
+    from ..data.streaming import ShardedFileDataset
     mode = getattr(trainer, "_async_mode", "pull_commit")
     placement = getattr(trainer, "async_workers", "threads")
 
-    xs, ys, _ = trainer._stage_data(dataset, trainer.communication_window)
+    if isinstance(dataset, ShardedFileDataset):
+        stream, xs, ys = _StreamPlan(trainer, dataset,
+                                     bool(stream_shuffle)), None, None
+    else:
+        stream = None
+        xs, ys, _ = trainer._stage_data(dataset,
+                                        trainer.communication_window)
 
     center = jax.tree_util.tree_map(np.asarray,
                                     trainer.model.init(trainer.seed))
@@ -77,10 +119,12 @@ def run_async_training(trainer, dataset, fault_injector=None):
     try:
         if placement == "processes":
             losses = _run_process_workers(trainer, ps, server, mode, center,
-                                          xs, ys, num_epoch, start_windows)
+                                          xs, ys, num_epoch, start_windows,
+                                          stream=stream)
         else:
             losses = _run_thread_workers(trainer, ps, server, mode, center,
-                                         xs, ys, num_epoch, start_windows)
+                                         xs, ys, num_epoch, start_windows,
+                                         stream=stream)
     finally:
         server.stop()
 
@@ -104,7 +148,7 @@ def run_async_training(trainer, dataset, fault_injector=None):
 # ---------------------------------------------------------------------------
 
 def _run_thread_workers(trainer, ps, server, mode, center, xs, ys, num_epoch,
-                        start_windows):
+                        start_windows, stream=None):
     loss_fn, optimizer = trainer._resolve()
     window_fn = make_window_fn(trainer.model, loss_fn, optimizer,
                                compute_dtype=trainer.compute_dtype,
@@ -124,7 +168,10 @@ def _run_thread_workers(trainer, ps, server, mode, center, xs, ys, num_epoch,
         w = worker_cls(k, window_fn, variables, opt_state, rng,
                        "127.0.0.1", server.port, num_epoch,
                        device=dev, start_window=start_windows[k], **kw)
-        w.set_data(xs[k], ys[k])
+        if stream is not None:
+            w.set_stream(stream.factory(k), stream.n_windows)
+        else:
+            w.set_data(xs[k], ys[k])
         workers.append(w)
     for w in workers:
         w.start()
@@ -150,7 +197,10 @@ def _run_thread_workers(trainer, ps, server, mode, center, xs, ys, num_epoch,
                 trainer.seed + 101 + w.worker_id), dev),
             "127.0.0.1", server.port, num_epoch, device=dev,
             start_window=ps.commits_by_worker.get(w.worker_id, 0), **kw)
-        retry.set_data(xs[w.worker_id], ys[w.worker_id])
+        if stream is not None:
+            retry.set_stream(stream.factory(w.worker_id), stream.n_windows)
+        else:
+            retry.set_data(xs[w.worker_id], ys[w.worker_id])
         retry.start()
         retry.join()
         if retry.error is not None:
@@ -188,7 +238,8 @@ def _spawn(spec: dict, td: str, k: int) -> subprocess.Popen:
 
 
 def _run_process_workers(trainer, ps, server, mode, center, xs, ys,
-                         num_epoch, start_windows, timeout: float = 1800.0):
+                         num_epoch, start_windows, stream=None,
+                         timeout: float = 1800.0):
     model_blob = serde.serialize_model(trainer.model, center)
     if not isinstance(trainer.worker_optimizer, str):
         # thread placement accepts optimizer OBJECTS (they stay in-process);
@@ -207,10 +258,23 @@ def _run_process_workers(trainer, ps, server, mode, center, xs, ys,
 
     def make_spec(k: int, blob: bytes, seed: int, td: str, attempt: int,
                   start_window: int):
-        data = os.path.join(td, f"data_{k}.npz")
-        if not os.path.exists(data):
-            np.savez(data, xs=xs[k], ys=ys[k])
+        if stream is not None:
+            # streaming workers read their shard partition straight from
+            # the dataset directory (shared filesystem — the reference's
+            # executors read their partition from HDFS the same way)
+            data_spec = {"stream": {
+                "dir": stream.source.directory,
+                "num_workers": stream.P, "batch_size": stream.bs,
+                "window": stream.w, "n_windows": stream.n_windows,
+                "cols": stream.cols, "shuffle": stream.shuffle,
+                "base_seed": stream.base_seed}}
+        else:
+            data = os.path.join(td, f"data_{k}.npz")
+            if not os.path.exists(data):
+                np.savez(data, xs=xs[k], ys=ys[k])
+            data_spec = {"data_npz": data}
         return {
+            **data_spec,
             "model_blob": blob,
             "worker_optimizer": trainer.worker_optimizer,
             "loss": trainer.loss,
@@ -223,7 +287,6 @@ def _run_process_workers(trainer, ps, server, mode, center, xs, ys,
             "worker_id": k, "host": "127.0.0.1", "port": server.port,
             "num_epoch": num_epoch, "seed": seed,
             "start_window": int(start_window),
-            "data_npz": data,
             "out_npz": os.path.join(td, f"out_{k}_{attempt}.npz"),
             "attempt": attempt,
         }

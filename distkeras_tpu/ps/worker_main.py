@@ -60,9 +60,6 @@ def run_spec(spec_path: str) -> None:
                                compute_dtype=shim.compute_dtype,
                                remat=shim.remat)
 
-    with np.load(spec["data_npz"]) as d:
-        xs, ys = d["xs"], d["ys"]
-
     import jax
     worker_cls = _WORKER_CLASSES[spec["mode"]]
     kw = {"alpha": spec["alpha"]} if spec["mode"] == "elastic" else {}
@@ -72,7 +69,26 @@ def run_spec(spec_path: str) -> None:
         jax.random.PRNGKey(int(spec["seed"])),
         spec["host"], int(spec["port"]), int(spec["num_epoch"]),
         start_window=int(spec.get("start_window", 0)), **kw)
-    worker.set_data(xs, ys)
+    if "stream" in spec:
+        # disk-streaming partition: this process reads ITS shards straight
+        # from the (shared) dataset directory — nothing was staged for it
+        from ..data.streaming import ShardedFileDataset, window_batches
+        s = spec["stream"]
+        source = ShardedFileDataset(s["dir"])
+        k, P = int(spec["worker_id"]), int(s["num_workers"])
+        bs, w = int(s["batch_size"]), int(s["window"])
+        cols = list(s["cols"])
+
+        def factory(epoch: int):
+            seed = (int(s["base_seed"]) + 1000 + epoch) if s["shuffle"] \
+                else None
+            return window_batches(
+                source.worker_batches(cols, bs, k, P, seed=seed), w)
+
+        worker.set_stream(factory, int(s["n_windows"]))
+    else:
+        with np.load(spec["data_npz"]) as d:
+            worker.set_data(d["xs"], d["ys"])
     worker.run()  # synchronously in THIS process (it is the worker process)
     # write the complete epochs this attempt produced BEFORE surfacing any
     # failure: the runner merges them with the retry's epochs, so a crash

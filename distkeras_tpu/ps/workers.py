@@ -75,6 +75,14 @@ class AsyncWorker(threading.Thread):
     def set_data(self, xs, ys):
         self.xs, self.ys = xs, ys
 
+    def set_stream(self, factory: Callable, n_windows: int):
+        """Disk-streaming data source: ``factory(epoch) -> iterator`` of
+        ``(wx, wy)`` window tuples, each ``(window, batch, ...)``.  The
+        worker streams its OWN shard partition instead of holding the
+        epoch in RAM (SURVEY.md §7 hard part 6)."""
+        self._stream_factory = factory
+        self._stream_windows = int(n_windows)
+
     def _put(self, tree):
         if self.device is not None:
             return _tmap(lambda x: jax.device_put(x, self.device), tree)
@@ -91,15 +99,20 @@ class AsyncWorker(threading.Thread):
             self.error = e
 
     def _train(self, client: PSClient):
-        n_windows = int(self.xs.shape[0])
+        stream = getattr(self, "_stream_factory", None)
+        n_windows = self._stream_windows if stream is not None \
+            else int(self.xs.shape[0])
         total = self.num_epoch * n_windows
         try:
-            for gw in range(self.start_window, total):
-                wi = gw % n_windows  # window within the epoch
-                wx = self._put(self.xs[wi])
-                wy = self._put(self.ys[wi])
-                losses = self._window(client, wx, wy)
-                self.window_losses.append((gw, np.asarray(losses)))
+            if stream is not None:
+                self._stream_epochs(client, stream, n_windows, total)
+            else:
+                for gw in range(self.start_window, total):
+                    wi = gw % n_windows  # window within the epoch
+                    wx = self._put(self.xs[wi])
+                    wy = self._put(self.ys[wi])
+                    losses = self._window(client, wx, wy)
+                    self.window_losses.append((gw, np.asarray(losses)))
         finally:
             # per-epoch view for the COMPLETE epochs this run covered —
             # built even on a crash so a retried worker's merge keeps the
@@ -113,6 +126,29 @@ class AsyncWorker(threading.Thread):
                                  if len(ls) == n_windows}
             self.losses = [self.epoch_losses[e]
                            for e in sorted(self.epoch_losses)]
+
+    def _stream_epochs(self, client: PSClient, factory: Callable,
+                       n_windows: int, total: int):
+        """Epoch loop over streamed windows; a resumed worker fast-forwards
+        its first epoch's iterator to the window its commits reached (the
+        skipped windows are read and dropped — disk IO, no compute)."""
+        gw = self.start_window
+        while gw < total:
+            epoch = gw // n_windows
+            it = factory(epoch)
+            try:
+                skip = gw % n_windows
+                for _ in range(skip):
+                    next(it)
+                for _ in range(skip, n_windows):
+                    wx, wy = next(it)
+                    losses = self._window(client, self._put(wx),
+                                          self._put(wy))
+                    self.window_losses.append((gw, np.asarray(losses)))
+                    gw += 1
+            finally:
+                if hasattr(it, "close"):
+                    it.close()
 
     def _run_window(self, wx, wy):
         self.variables, self.opt_state, self.rng, losses = self.window_fn(

@@ -435,6 +435,14 @@ class DistributedTrainer(Trainer):
 
     # -- training -----------------------------------------------------------
     def _train(self, dataset: Dataset, shuffle: bool) -> Model:
+        from .data.streaming import ShardedFileDataset
+        if isinstance(dataset, ShardedFileDataset):
+            # disk-streaming path: every worker streams ITS shard partition
+            # (partition == worker, SURVEY.md §3.1 boundary #1); the whole
+            # epoch is never resident in host RAM or HBM
+            if self.mode == "async":
+                return self._train_async(dataset, stream_shuffle=shuffle)
+            return self._train_sync_stream(dataset, shuffle)
         if shuffle:
             dataset = dataset.shuffle(self.seed)
         if self.mode == "async":
@@ -447,9 +455,10 @@ class DistributedTrainer(Trainer):
             id(self.mesh) if self.mesh is not None else None,
             getattr(self, "rho", None), getattr(self, "momentum", None))
 
-    def _engine_run(self):
-        """Cached jit epoch program + mesh + optimizer (see
-        ``Trainer._window_run`` — same reuse-across-train()-calls story)."""
+    def _engine_parts(self):
+        """Cached (engine, mesh, optimizer, programs) for the current
+        hyperparameters; ``programs`` caches the compiled epoch/window
+        executables so repeated ``train()`` calls skip re-tracing."""
         key = self._config_key()
         cached = getattr(self, "_engine_cache", None)
         if cached is None or cached[0] != key:
@@ -461,8 +470,23 @@ class DistributedTrainer(Trainer):
                                 self.communication_window, mesh=mesh,
                                 compute_dtype=self.compute_dtype,
                                 remat=self.remat)
-            self._engine_cache = (key, engine.epoch_fn(), mesh, optimizer)
+            self._engine_cache = (key, engine, mesh, optimizer, {})
         return self._engine_cache[1:]
+
+    def _engine_run(self):
+        """Cached jit epoch program + mesh + optimizer (see
+        ``Trainer._window_run`` — same reuse-across-train()-calls story)."""
+        engine, mesh, optimizer, programs = self._engine_parts()
+        if "epoch" not in programs:
+            programs["epoch"] = engine.epoch_fn()
+        return programs["epoch"], mesh, optimizer
+
+    def _engine_window(self):
+        """Cached jit single-window program (streaming path)."""
+        engine, mesh, optimizer, programs = self._engine_parts()
+        if "window" not in programs:
+            programs["window"] = engine.window_fn()
+        return programs["window"], mesh, optimizer
 
     def _train_sync(self, dataset: Dataset) -> Model:
         run, mesh, optimizer = self._engine_run()
@@ -506,14 +530,89 @@ class DistributedTrainer(Trainer):
         ``PS.get_model()``)."""
         return self._finish(center)
 
-    def _train_async(self, dataset: Dataset) -> Model:
+    # -- disk-streaming sync path (SURVEY.md §7 hard part 6) ----------------
+    def _stream_locals(self, P: int):
+        """(center, local) initial host pytrees for the streaming path;
+        local's leading axis is workers.  Default: all workers start from
+        the center init (EnsembleTrainer decorrelates seeds instead)."""
+        center = self.model.init(self.seed)
+        local = tmap(lambda x: np.broadcast_to(np.asarray(x)[None],
+                                               (P, *np.shape(x))), center)
+        return center, local
+
+    def _train_sync_stream(self, source, shuffle: bool) -> Model:
+        """Synchronous epochs streamed from disk: each worker's shard
+        partition feeds its mesh slot window-by-window; the host (with
+        per-worker prefetch threads) assembles window w+1 while the devices
+        train window w.  Peak host memory is O(P × window × batch), never
+        the epoch."""
+        from .data.streaming import window_batches
+        run, mesh, optimizer = self._engine_window()
+        P = self.num_workers
+        w = self.communication_window
+        bs = self.batch_size
+        steps = source.worker_steps_per_epoch(bs, P)
+        n_windows = steps // w
+        if n_windows == 0:
+            raise ValueError(
+                f"communication_window {w} exceeds the {steps} steps "
+                f"available per worker (decrease window/batch_size or add "
+                f"data)")
+
+        center, local = self._stream_locals(P)
+        center = mesh_lib.broadcast_to_mesh(mesh, center)
+        local = mesh_lib.host_to_mesh(mesh, local)
+        opt_state = jax.vmap(optimizer.init)(local["params"])
+        rngs = jax.random.split(jax.random.PRNGKey(self.seed + 1), P)
+        rngs = mesh_lib.host_to_mesh(mesh, rngs)
+
+        ckpt = self._ckpt_manager()
+        (center, local, opt_state, rngs), start_epoch = self._maybe_restore(
+            ckpt, (center, local, opt_state, rngs))
+        if start_epoch:  # restored host arrays need re-placing on the mesh
+            center = mesh_lib.broadcast_to_mesh(mesh, center)
+            local = mesh_lib.host_to_mesh(mesh, local)
+            opt_state = mesh_lib.host_to_mesh(mesh, opt_state)
+            rngs = mesh_lib.host_to_mesh(mesh, rngs)
+
+        cols = [self.features_col, self.label_col]
+        samples = n_windows * w * bs * P
+        pipe = _EpochPipeline(self, samples, reshape=(P, -1))
+        for epoch in range(start_epoch, self.num_epoch):
+            seed = (self.seed + 1000 + epoch) if shuffle else None
+            its = [window_batches(
+                       source.worker_batches(cols, bs, k, P, seed=seed), w)
+                   for k in range(P)]
+            losses = []
+            try:
+                for _ in range(n_windows):
+                    grp = [next(it) for it in its]
+                    wx = np.stack([g[0] for g in grp])  # (P, w, B, ...)
+                    wy = np.stack([g[1] for g in grp])
+                    center, local, opt_state, rngs, l = run(
+                        center, local, opt_state, rngs,
+                        mesh_lib.host_to_mesh(mesh, wx),
+                        mesh_lib.host_to_mesh(mesh, wy))
+                    losses.append(l)  # (P, w) device array, not synced
+            finally:
+                for it in its:
+                    it.close()
+            pipe.push(epoch, jnp.concatenate(losses, axis=1))
+            if ckpt is not None:  # note: saving implies a per-epoch sync
+                ckpt.save(epoch, (center, local, opt_state, rngs),
+                          {"epoch": epoch})
+        pipe.flush()
+        return self._collect(center, local)
+
+    def _train_async(self, dataset, stream_shuffle: Optional[bool] = None):
         try:
             from .ps.runner import run_async_training
         except ImportError as e:
             raise NotImplementedError(
                 "async parameter-server mode requires the distkeras_tpu.ps "
                 "package") from e
-        return run_async_training(self, dataset)
+        return run_async_training(self, dataset,
+                                  stream_shuffle=stream_shuffle)
 
 
 class AveragingTrainer(DistributedTrainer):
@@ -548,6 +647,26 @@ class EnsembleTrainer(DistributedTrainer):
 
     def _sync_algorithm(self):
         return NoCommSync()
+
+    def _stream_locals(self, P: int):
+        # independent decorrelated inits per ensemble member (same rule as
+        # the in-RAM path below)
+        fresh = getattr(self.model, "reinit", self.model.init)
+        inits = [fresh(self.seed + i) for i in range(P)]
+        local = tmap(lambda *xs_: np.stack([np.asarray(x) for x in xs_]),
+                     *inits)
+        return inits[0], local
+
+    def _collect(self, center, local):
+        # streaming path lands here: N independent models, all returned
+        local = jax.tree_util.tree_map(np.asarray, local)
+        models = []
+        for i in range(self.num_workers):
+            m = type(self.model).from_config(self.model.config())
+            m.variables = tmap(lambda l: l[i], local)
+            models.append(m)
+        self.trained_variables = models[0].variables
+        return models
 
     def _train_sync(self, dataset: Dataset):
         run, mesh, optimizer = self._engine_run()

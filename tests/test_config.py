@@ -15,11 +15,12 @@ BENCH_YAML = os.path.join(ROOT, "configs", "bench_all.yaml")
 
 def test_bench_yaml_loads_all_configs():
     cfgs = cfg_mod.load_file(BENCH_YAML)
-    # five BASELINE configs + LM config + streaming variant of #5
-    assert len(cfgs) == 7
+    # five BASELINE configs + LM config + distributed-streaming row +
+    # streaming variant of #5
+    assert len(cfgs) == 8
     assert [c.trainer for c in cfgs] == [
         "SingleTrainer", "ADAG", "DOWNPOUR", "AEASGD", "DynSGD",
-        "SingleTrainer", "SingleTrainer"]
+        "SingleTrainer", "ADAG", "SingleTrainer"]
     # every config builds a real trainer of the right class with the right
     # hyperparameters (quick variant keeps data small)
     c = cfgs[1].with_quick()
@@ -49,10 +50,23 @@ def test_streaming_config_trains_from_disk():
     assert row["samples_per_sec"] > 0
 
 
-def test_streaming_requires_single_trainer():
-    c = RunConfig(name="x", trainer="DynSGD", streaming=True)
-    with pytest.raises(ValueError, match="streaming: requires"):
-        cfg_mod.build(c)
+def test_streaming_config_distributed_trainer():
+    """``streaming:`` also feeds DISTRIBUTED trainers (VERDICT r3 missing
+    #1): the default shard size guarantees >= one shard per worker."""
+    from distkeras_tpu.data.streaming import ShardedFileDataset
+    c = RunConfig(name="stream dist", trainer="ADAG",
+                  model="mlp_mnist", model_kwargs={"hidden": 32},
+                  dataset="load_mnist", dataset_kwargs={"n_train": 2048},
+                  onehot=10, test_take=256, streaming=True,
+                  trainer_kwargs={"num_workers": 4, "num_epoch": 4,
+                                  "batch_size": 32, "learning_rate": 0.1,
+                                  "communication_window": 2})
+    trainer, train, test = cfg_mod.build(c)
+    assert isinstance(train, ShardedFileDataset)
+    assert len(train.shards) >= 4
+    row = cfg_mod.run(c)
+    assert row["accuracy"] > 0.7
+    assert row["samples_per_sec"] > 0
 
 
 def test_quick_overrides_merge_not_replace():

