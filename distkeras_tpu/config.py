@@ -116,18 +116,27 @@ def build(cfg: RunConfig):
         import jax
         kw["num_workers"] = min(8, len(jax.devices()))
 
+    trainer_cls = getattr(dk, cfg.trainer)
     if cfg.streaming:
         import atexit
         import shutil
         import tempfile
         from .data.streaming import ShardedFileDataset
+        from .trainers import DistributedTrainer, SingleTrainer
+        if not issubclass(trainer_cls, (SingleTrainer, DistributedTrainer)):
+            # fail at build time with a clear message, not mid-train
+            raise ValueError(
+                f"streaming: trainer {cfg.trainer!r} has no "
+                f"ShardedFileDataset path (supported: SingleTrainer and "
+                f"the distributed trainer family)")
         if isinstance(cfg.streaming, int) and \
                 not isinstance(cfg.streaming, bool):
             rows = cfg.streaming
         else:
             # default shard size, capped so a distributed trainer gets at
-            # least one shard per worker (partition == worker)
-            nw = int(kw.get("num_workers") or 1)
+            # least one shard per worker (partition == worker);
+            # EnsembleTrainer sizes its workers from num_ensembles
+            nw = int(kw.get("num_workers") or kw.get("num_ensembles") or 1)
             rows = min(4096, max(1, train.num_rows // max(1, nw)))
         spill_dir = tempfile.mkdtemp(prefix="dk_stream_")
         # the spill is run-scoped scratch, not a dataset the user keeps:
@@ -136,7 +145,6 @@ def build(cfg: RunConfig):
         train = ShardedFileDataset.write(train, spill_dir,
                                          rows_per_shard=rows)
 
-    trainer_cls = getattr(dk, cfg.trainer)
     return trainer_cls(model, **kw), train, test
 
 

@@ -128,13 +128,18 @@ class ShardedFileDataset:
                        engine: str = "thread", prefetch: int = 4,
                        seed: Optional[int] = None) -> Iterator[tuple]:
         """Stream batches drawn only from ``worker``'s shard partition.
-        ``seed`` is decorrelated per worker (shard order + in-shard perm)."""
+        ``seed`` is decorrelated per worker (shard order + in-shard perm).
+        ``engine="thread"`` (default) prefetches in a producer thread;
+        ``"raw"`` iterates synchronously (a caller that already overlaps
+        IO, e.g. a worker thread of its own)."""
         idx = self.worker_shard_indices(worker, num_workers)
         wseed = None if seed is None else (seed * num_workers + worker + 1)
         src = self._batch_source(cols, batch_size, wseed, shard_indices=idx)
         if engine == "thread":
             return _prefetched(src, prefetch)
-        return src
+        if engine == "raw":
+            return src
+        raise ValueError(f"engine must be thread|raw, got {engine!r}")
 
     def _load(self, name: str) -> dict:
         with np.load(os.path.join(self.directory, name)) as d:
@@ -217,6 +222,37 @@ def window_batches(it: Iterator[tuple], window: int) -> Iterator[tuple]:
         # must release the source's prefetch thread/shard immediately
         if hasattr(it, "close"):
             it.close()
+
+
+def worker_windows_per_epoch(source: "ShardedFileDataset", batch_size: int,
+                             num_workers: int, window: int) -> int:
+    """Common per-worker window count per epoch, validated — the single
+    arithmetic every streaming consumer (sync trainer, async runner) uses."""
+    steps = source.worker_steps_per_epoch(batch_size, num_workers)
+    n = steps // window
+    if n == 0:
+        raise ValueError(
+            f"communication_window {window} exceeds the {steps} steps "
+            f"available per worker (decrease window/batch_size or add data)")
+    return n
+
+
+def worker_window_factory(source: "ShardedFileDataset", cols: Sequence[str],
+                          batch_size: int, worker: int, num_workers: int,
+                          window: int, base_seed: int, shuffle: bool):
+    """``factory(epoch) -> iterator`` of stacked ``(window, batch, ...)``
+    column tuples over ``worker``'s shard partition.
+
+    This is THE shared recipe — per-epoch seed derivation included — for
+    all three streaming consumers (sync trainer loop, async thread
+    workers, async process workers): one formula, so data order stays
+    bit-identical across placements."""
+    def make(epoch: int):
+        seed = (base_seed + 1000 + epoch) if shuffle else None
+        return window_batches(
+            source.worker_batches(cols, batch_size, worker, num_workers,
+                                  seed=seed), window)
+    return make
 
 
 def _has_tf() -> bool:

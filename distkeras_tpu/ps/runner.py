@@ -44,6 +44,7 @@ class _StreamPlan:
     shard partition of a ``ShardedFileDataset``; nothing is staged in RAM."""
 
     def __init__(self, trainer, source, shuffle: bool):
+        from ..data.streaming import worker_windows_per_epoch
         self.source = source
         self.shuffle = bool(shuffle)
         self.P = trainer.num_workers
@@ -51,23 +52,14 @@ class _StreamPlan:
         self.w = trainer.communication_window
         self.cols = [trainer.features_col, trainer.label_col]
         self.base_seed = trainer.seed
-        steps = source.worker_steps_per_epoch(self.bs, self.P)
-        self.n_windows = steps // self.w
-        if self.n_windows == 0:
-            raise ValueError(
-                f"communication_window {self.w} exceeds the {steps} steps "
-                f"available per worker (decrease window/batch_size or add "
-                f"data)")
+        self.n_windows = worker_windows_per_epoch(source, self.bs, self.P,
+                                                  self.w)
 
     def factory(self, k: int):
-        from ..data.streaming import window_batches
-
-        def make(epoch: int):
-            seed = (self.base_seed + 1000 + epoch) if self.shuffle else None
-            return window_batches(
-                self.source.worker_batches(self.cols, self.bs, k, self.P,
-                                           seed=seed), self.w)
-        return make
+        from ..data.streaming import worker_window_factory
+        return worker_window_factory(self.source, self.cols, self.bs, k,
+                                     self.P, self.w, self.base_seed,
+                                     self.shuffle)
 
 
 def run_async_training(trainer, dataset, fault_injector=None,

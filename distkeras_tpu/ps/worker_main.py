@@ -72,19 +72,13 @@ def run_spec(spec_path: str) -> None:
     if "stream" in spec:
         # disk-streaming partition: this process reads ITS shards straight
         # from the (shared) dataset directory — nothing was staged for it
-        from ..data.streaming import ShardedFileDataset, window_batches
+        from ..data.streaming import ShardedFileDataset, worker_window_factory
         s = spec["stream"]
-        source = ShardedFileDataset(s["dir"])
-        k, P = int(spec["worker_id"]), int(s["num_workers"])
-        bs, w = int(s["batch_size"]), int(s["window"])
-        cols = list(s["cols"])
-
-        def factory(epoch: int):
-            seed = (int(s["base_seed"]) + 1000 + epoch) if s["shuffle"] \
-                else None
-            return window_batches(
-                source.worker_batches(cols, bs, k, P, seed=seed), w)
-
+        factory = worker_window_factory(
+            ShardedFileDataset(s["dir"]), list(s["cols"]),
+            int(s["batch_size"]), int(spec["worker_id"]),
+            int(s["num_workers"]), int(s["window"]), int(s["base_seed"]),
+            bool(s["shuffle"]))
         worker.set_stream(factory, int(s["n_windows"]))
     else:
         with np.load(spec["data_npz"]) as d:

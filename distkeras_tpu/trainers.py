@@ -546,18 +546,13 @@ class DistributedTrainer(Trainer):
         per-worker prefetch threads) assembles window w+1 while the devices
         train window w.  Peak host memory is O(P × window × batch), never
         the epoch."""
-        from .data.streaming import window_batches
+        from .data.streaming import (worker_window_factory,
+                                     worker_windows_per_epoch)
         run, mesh, optimizer = self._engine_window()
         P = self.num_workers
         w = self.communication_window
         bs = self.batch_size
-        steps = source.worker_steps_per_epoch(bs, P)
-        n_windows = steps // w
-        if n_windows == 0:
-            raise ValueError(
-                f"communication_window {w} exceeds the {steps} steps "
-                f"available per worker (decrease window/batch_size or add "
-                f"data)")
+        n_windows = worker_windows_per_epoch(source, bs, P, w)
 
         center, local = self._stream_locals(P)
         center = mesh_lib.broadcast_to_mesh(mesh, center)
@@ -576,13 +571,13 @@ class DistributedTrainer(Trainer):
             rngs = mesh_lib.host_to_mesh(mesh, rngs)
 
         cols = [self.features_col, self.label_col]
+        factories = [worker_window_factory(source, cols, bs, k, P, w,
+                                           self.seed, shuffle)
+                     for k in range(P)]
         samples = n_windows * w * bs * P
         pipe = _EpochPipeline(self, samples, reshape=(P, -1))
         for epoch in range(start_epoch, self.num_epoch):
-            seed = (self.seed + 1000 + epoch) if shuffle else None
-            its = [window_batches(
-                       source.worker_batches(cols, bs, k, P, seed=seed), w)
-                   for k in range(P)]
+            its = [f(epoch) for f in factories]
             losses = []
             try:
                 for _ in range(n_windows):
@@ -658,10 +653,11 @@ class EnsembleTrainer(DistributedTrainer):
         return inits[0], local
 
     def _collect(self, center, local):
-        # streaming path lands here: N independent models, all returned
+        # N independent models, all returned (in-RAM and streaming paths)
         local = jax.tree_util.tree_map(np.asarray, local)
         models = []
         for i in range(self.num_workers):
+            # type(...) so ingested Keras models (KerasAdapter) work too
             m = type(self.model).from_config(self.model.config())
             m.variables = tmap(lambda l: l[i], local)
             models.append(m)
@@ -707,16 +703,7 @@ class EnsembleTrainer(DistributedTrainer):
                 ckpt.save(epoch, (center, local, opt_state, rngs),
                           {"epoch": epoch})
         pipe.flush()
-
-        local = jax.tree_util.tree_map(np.asarray, local)
-        models = []
-        for i in range(P):
-            # type(...) so ingested Keras models (KerasAdapter) work too
-            m = type(self.model).from_config(self.model.config())
-            m.variables = tmap(lambda l: l[i], local)
-            models.append(m)
-        self.trained_variables = models[0].variables
-        return models
+        return self._collect(center, local)
 
 
 class SpmdTrainer(Trainer):
