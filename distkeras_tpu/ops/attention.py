@@ -63,7 +63,9 @@ def _flash_with_blocking(q, k, v, causal: bool, t: int):
     from .pallas_attention import flash_attention
     blk = _largest_divisor_block(t)
     if blk >= _MIN_FLASH_BLOCK or t <= _MIN_FLASH_BLOCK:
-        return flash_attention(q, k, v, causal, blk, blk)
+        # block sizes auto-tune inside the kernel (largest VMEM-fitting
+        # divisor of T — the big-block regime is where flash beats dense)
+        return flash_attention(q, k, v, causal)
     if not causal:
         raise ValueError(
             f"impl='flash' needs a sequence length with a block-sized "
@@ -73,7 +75,7 @@ def _flash_with_blocking(q, k, v, causal: bool, t: int):
             f"masking) or use impl='dense'.")
     pad = -t % 128
     padded = [jnp.pad(a, ((0, 0), (0, pad), (0, 0), (0, 0))) for a in (q, k, v)]
-    return flash_attention(*padded, True, 128, 128)[:, :t]
+    return flash_attention(*padded, True)[:, :t]
 
 
 @register
