@@ -14,27 +14,51 @@ from .data.dataset import Dataset
 
 
 class Evaluator:
-    """Base evaluator (reference ``distkeras/evaluators.py:Evaluator``)."""
+    """Base evaluator (reference ``distkeras/evaluators.py:Evaluator``).
+
+    ``prediction_kind`` / ``label_kind`` disambiguate what the columns
+    hold: ``"auto"`` (default — infer, see ``_to_class_index``),
+    ``"ids"`` (class indices, any shape), ``"onehot"`` (one-hot or
+    probability vectors, argmaxed on the last axis).  Pass an explicit
+    kind when auto-inference is ambiguous — e.g. integer (B, T) per-token
+    targets over a binary vocabulary, which value-based inference could
+    misread as one-hot rows (ADVICE r3)."""
 
     def __init__(self, prediction_col: str = "prediction",
-                 label_col: str = "label"):
+                 label_col: str = "label", prediction_kind: str = "auto",
+                 label_kind: str = "auto"):
         self.prediction_col = prediction_col
         self.label_col = label_col
+        for kind in (prediction_kind, label_kind):
+            if kind not in ("auto", "ids", "onehot"):
+                raise ValueError(
+                    f"kind must be auto|ids|onehot, got {kind!r}")
+        self.prediction_kind = prediction_kind
+        self.label_kind = label_kind
 
     def evaluate(self, dataset: Dataset) -> float:
         raise NotImplementedError
 
 
-def _to_class_index(a: np.ndarray, threshold: float = 0.5) -> np.ndarray:
+def _to_class_index(a: np.ndarray, threshold: float = 0.5,
+                    kind: str = "auto") -> np.ndarray:
     """Accept class indices (any shape — (B,) classifiers or (B, T)
     per-token LM targets), one-hot/probability vectors (argmaxed on the
     last axis), or (for the binary 1-column case) sigmoid probabilities
-    thresholded at 0.5."""
+    thresholded at 0.5.  ``kind`` overrides the inference ("ids" /
+    "onehot"); integer one-hot auto-detection is restricted to 2-D
+    arrays, so (B, T, V) integer targets need the explicit kind."""
     a = np.asarray(a)
+    if kind == "onehot":
+        return np.argmax(a, axis=-1)
+    if kind == "ids":
+        if a.ndim >= 2 and a.shape[-1] == 1:
+            a = a[..., 0]
+        return a.astype(np.int64)
     if np.issubdtype(a.dtype, np.integer) or a.dtype == bool:
         if a.ndim >= 2 and a.shape[-1] == 1:
             a = a[..., 0]
-        if a.ndim >= 2 and a.shape[-1] > 1 and a.min() >= 0 \
+        if a.ndim == 2 and a.shape[-1] > 1 and a.min() >= 0 \
                 and a.max() <= 1 and np.all(a.sum(axis=-1) == 1):
             return np.argmax(a, axis=-1)  # integer one-hot rows
         return a.astype(np.int64)         # class ids, (B,) or (B, T)
@@ -53,8 +77,10 @@ class AccuracyEvaluator(Evaluator):
     runs ``LabelIndexTransformer``; we accept raw vectors too)."""
 
     def evaluate(self, dataset: Dataset) -> float:
-        pred = _to_class_index(dataset[self.prediction_col])
-        label = _to_class_index(dataset[self.label_col])
+        pred = _to_class_index(dataset[self.prediction_col],
+                               kind=self.prediction_kind)
+        label = _to_class_index(dataset[self.label_col],
+                                kind=self.label_kind)
         return float(np.mean(pred == label))
 
 
@@ -63,8 +89,10 @@ class F1Evaluator(Evaluator):
     via ``MulticlassClassificationEvaluator``)."""
 
     def evaluate(self, dataset: Dataset) -> float:
-        pred = _to_class_index(dataset[self.prediction_col])
-        label = _to_class_index(dataset[self.label_col])
+        pred = _to_class_index(dataset[self.prediction_col],
+                               kind=self.prediction_kind)
+        label = _to_class_index(dataset[self.label_col],
+                                kind=self.label_kind)
         classes = np.unique(np.concatenate([pred, label]))
         f1s = []
         for c in classes:

@@ -19,9 +19,18 @@ Two decode strategies, both ONE compiled program:
   training forward on the whole buffer each step — O(T²·D) per token
   but correct for ANY causal model, because it reuses the exact training
   forward.  Auto-selected for mesh-attached (ring-sharded) attention
-  (per-chip full-length caches would defeat the sharding) and for
-  hybrid stacks containing a time-mixing layer without its own decode
-  rule (``Layer.time_mixing``).
+  (per-chip full-length caches would defeat the sharding), for hybrid
+  stacks containing a time-mixing layer without its own decode rule
+  (``Layer.time_mixing``), and for RAGGED prompt batches.
+
+Sampling controls: ``temperature`` (0 → greedy), ``top_k``, ``top_p``
+(nucleus), composable.  ``eos_id`` freezes a row once it emits EOS
+(masked continue inside the scan — static shapes, rows finish
+independently).  Ragged prompts: pass right-padded ``prompt`` plus
+``prompt_lengths``; each row's continuation is written at its own
+positions (causal attention ignores the right padding, so content keeps
+its physical positions 0..len-1 and the training forward stays exact —
+no position-id plumbing needed).
 
 With ``temperature > 0`` the two strategies consume PRNG splits in the
 same order, so a given seed yields the same continuation on either path.
@@ -29,11 +38,24 @@ same order, so a given seed yields the same continuation on either path.
 
 from __future__ import annotations
 
+from collections import OrderedDict
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .layers import Layer
+
+#: compiled decode runners kept per model (LRU): eval loops over many
+#: distinct (prompt_len, num_steps, ...) shapes would otherwise retain one
+#: executable EACH for the model's lifetime (ADVICE r3)
+_RUNNER_CACHE_MAX = 16
+
+# plain Python float: a module-level jnp scalar would initialize the XLA
+# backend at import time, breaking jax.distributed.initialize for any
+# program that imports the package first (multihost bring-up contract)
+_NEG = -1e30
 
 
 def _model_cache(model, batch):
@@ -55,31 +77,96 @@ def _model_cache(model, batch):
     return cache if jax.tree_util.tree_leaves(cache) else None
 
 
+def _filter_logits(logits, top_k, top_p):
+    """top-k / nucleus (top-p) filtering; composable, batch-wise."""
+    if top_k is not None:
+        kth = lax.top_k(logits, int(top_k))[0][..., -1:]
+        logits = jnp.where(logits < kth, _NEG, logits)
+    if top_p is not None:
+        sorted_desc = -jnp.sort(-logits, axis=-1)
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest prefix with mass >= top_p: keep entries whose EXCLUSIVE
+        # cumulative mass is still below the threshold
+        keep = (cum - probs) < top_p
+        thresh = jnp.min(jnp.where(keep, sorted_desc, jnp.inf),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits < thresh, _NEG, logits)
+    return logits
+
+
 def generate_tokens(model, variables, prompt, num_steps: int,
                     temperature: float = 0.0, seed: int = 0,
-                    use_cache=None):
+                    use_cache=None, top_k=None, top_p=None,
+                    eos_id=None, prompt_lengths=None):
     """Generate ``num_steps`` tokens after ``prompt``.
 
     model: a causal LM whose ``apply(variables, x)`` maps (B, T) int
     tokens → (B, T, V) logits, T = ``model.input_shape[0]``.
     prompt: (B, P) int array, 1 <= P, P + num_steps <= T.
     temperature: 0.0 → greedy argmax; > 0 → categorical sampling.
+    top_k / top_p: sampling filters (applied in that order); only
+    meaningful with temperature > 0 (argmax is unaffected by filtering).
+    eos_id: once a row samples this token its continuation freezes
+    (further positions repeat ``eos_id``) while other rows continue.
+    prompt_lengths: (B,) true lengths for RIGHT-padded ragged prompts;
+    row b's content is ``prompt[b, :prompt_lengths[b]]`` and its
+    continuation lands at positions ``len_b .. len_b+num_steps-1``.
+    Ragged batches run the full-context strategy (the KV cache protocol
+    is uniform-position; recompute reuses the exact training forward).
     use_cache: None → auto (KV-cached when the model supports it);
     True forces the cached path (raises if unsupported); False forces
     full-context recompute.
 
-    Returns (B, P + num_steps) int32 — prompt + continuation.  The whole
-    loop is jit-compiled (scan over positions, one-hot position
+    Returns (B, P + num_steps) int32 — prompt + continuation (ragged
+    rows keep their right padding; content ends at len_b + num_steps).
+    The whole loop is jit-compiled (scan over positions, one-hot position
     read/write — no gather/scatter shape surprises on TPU).
     """
     t = int(model.input_shape[0])
     prompt = jnp.asarray(prompt, jnp.int32)
     b, p = prompt.shape
+    num_steps = int(num_steps)
+    if num_steps < 0:
+        raise ValueError(f"num_steps must be >= 0, got {num_steps}")
+    if top_k is not None and int(top_k) < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if not 1 <= p <= t - num_steps:
         raise ValueError(f"prompt length {p} + {num_steps} steps exceeds "
                          f"the model's seq_len {t}")
+    if num_steps == 0:
+        # the degenerate call is the prompt itself on BOTH strategies
+        # (ADVICE r3: the cached runner's trailing sample would otherwise
+        # corrupt the last prompt token); validation above still applies
+        return prompt
 
-    cache = _model_cache(model, b) if use_cache in (None, True) else None
+    ragged = False
+    lengths = None
+    if prompt_lengths is not None:
+        lengths = np.asarray(prompt_lengths, np.int32)
+        if lengths.shape != (b,):
+            raise ValueError(f"prompt_lengths shape {lengths.shape} != "
+                             f"({b},)")
+        if lengths.min() < 1 or lengths.max() > p:
+            raise ValueError(f"prompt_lengths must lie in [1, {p}]")
+        if int(lengths.max()) + num_steps > t:
+            raise ValueError(
+                f"longest prompt {int(lengths.max())} + {num_steps} steps "
+                f"exceeds the model's seq_len {t}")
+        ragged = bool((lengths != lengths.max()).any()) or int(
+            lengths.max()) != p
+    if ragged and use_cache is True:
+        raise ValueError(
+            "use_cache=True is incompatible with ragged prompt_lengths: "
+            "the KV-cache decode protocol writes at one uniform position "
+            "per step; omit use_cache (full-context recompute handles "
+            "ragged rows exactly)")
+
+    cache = None
+    if not ragged and use_cache in (None, True):
+        cache = _model_cache(model, b)
     if use_cache is True and cache is None:
         raise ValueError(
             "use_cache=True but the cached decode path is unsupported "
@@ -89,33 +176,50 @@ def generate_tokens(model, variables, prompt, num_steps: int,
             "use_cache=False (full-context recompute)")
 
     buf = jnp.zeros((b, t), jnp.int32).at[:, :p].set(prompt)
+    eos = None if eos_id is None else jnp.int32(int(eos_id))
 
-    # compiled runners are cached ON the model, keyed by everything the
-    # closure bakes in — repeated generate_tokens calls (eval loops,
-    # different seeds) reuse one compiled scan instead of retracing
-    key = (p, int(num_steps), float(temperature), cache is not None, b)
+    # compiled runners are cached ON the model (bounded LRU), keyed by
+    # everything the closure bakes in — repeated generate_tokens calls
+    # (eval loops, different seeds) reuse one compiled scan per shape
+    key = (p, num_steps, float(temperature), cache is not None, b,
+           None if top_k is None else int(top_k),
+           None if top_p is None else float(top_p),
+           None if eos_id is None else int(eos_id), ragged)
     runners = getattr(model, "_generate_cache", None)
     if runners is None:
-        runners = model._generate_cache = {}
+        runners = model._generate_cache = OrderedDict()
     run = runners.get(key)
+    if run is not None:
+        runners.move_to_end(key)
 
     if run is None:
-        def sample(next_logits, rng):
+        def sample(next_logits, rng, done):
             if temperature > 0.0:
                 rng, sub = jax.random.split(rng)
-                nxt = jax.random.categorical(
-                    sub, next_logits / temperature, axis=-1)
+                filtered = _filter_logits(next_logits / temperature,
+                                          top_k, top_p)
+                nxt = jax.random.categorical(sub, filtered, axis=-1)
             else:
                 nxt = jnp.argmax(next_logits, axis=-1)
-            return nxt.astype(jnp.int32), rng
+            nxt = nxt.astype(jnp.int32)
+            if eos is not None:
+                # masked continue: finished rows repeat EOS; the done flag
+                # latches on the first EOS emission
+                nxt = jnp.where(done, eos, nxt)
+                done = done | (nxt == eos)
+            return nxt, rng, done
 
-        def write_after(buf, nxt, pos):
-            """Write ``nxt`` into buf[:, pos+1] (one-hot update)."""
-            w = jax.nn.one_hot(pos + 1, t, dtype=jnp.int32)
-            return buf * (1 - w)[None, :] + nxt[:, None] * w[None, :]
+        def write_at(buf, nxt, pos):
+            """Write ``nxt`` into buf[:, pos]; ``pos`` scalar or (B,)."""
+            w = jax.nn.one_hot(pos, t, dtype=jnp.int32)
+            if w.ndim == 1:
+                w = w[None, :]
+            return buf * (1 - w) + nxt[:, None] * w
+
+        done0 = jnp.zeros((b,), bool)
 
         if cache is not None:
-            def _run(variables, buf, cache, rng):
+            def _run(variables, buf, cache, rng, _lens):
                 params, state = variables["params"], variables["state"]
                 # batched prefill: one forward fills every layer's cache
                 # (entries past the prompt are masked placeholders,
@@ -125,37 +229,48 @@ def generate_tokens(model, variables, prompt, num_steps: int,
                 logits0 = y[:, p - 1]
 
                 def step(carry, i):
-                    buf, cache, rng, logits_prev = carry
-                    nxt, rng = sample(logits_prev, rng)
+                    buf, cache, rng, logits_prev, done = carry
+                    nxt, rng, done = sample(logits_prev, rng, done)
                     pos = p - 1 + i
-                    buf = write_after(buf, nxt, pos)
+                    buf = write_at(buf, nxt, pos + 1)
                     logits_t, cache = model.layer.apply_decode(
                         params, state, nxt, cache, pos + 1)
-                    return (buf, cache, rng, logits_t), None
+                    return (buf, cache, rng, logits_t, done), None
 
                 # num_steps-1 decode forwards (logits0 covers the first
                 # token); the last token needs only a sample + write
-                (buf, _, rng, logits_prev), _ = lax.scan(
-                    step, (buf, cache, rng, logits0),
+                (buf, _, rng, logits_prev, done), _ = lax.scan(
+                    step, (buf, cache, rng, logits0, done0),
                     jnp.arange(num_steps - 1))
-                last, _ = sample(logits_prev, rng)
-                return write_after(buf, last, p - 2 + num_steps)
+                last, _, _ = sample(logits_prev, rng, done)
+                return write_at(buf, last, p - 1 + num_steps)
         else:
-            def _run(variables, buf, cache, rng):
-                def step(carry, i):
-                    buf, rng = carry
-                    logits, _ = model.apply(variables, buf, train=False)
-                    pos = p - 1 + i
-                    sel = jax.nn.one_hot(pos, t, dtype=logits.dtype)
-                    next_logits = jnp.einsum("btv,t->bv", logits, sel)
-                    nxt, rng = sample(next_logits, rng)
-                    return (write_after(buf, nxt, pos), rng), None
+            def _run(variables, buf, cache, rng, lens):
+                # per-row positions: uniform prompts degenerate to a
+                # broadcast scalar; ragged rows each read/write their own
+                # slot (right padding sits in the causal FUTURE of every
+                # written position, so it never leaks into the content)
+                base = (jnp.full((b,), p, jnp.int32) if lens is None
+                        else lens)
 
-                (buf, _), _ = lax.scan(step, (buf, rng),
-                                       jnp.arange(num_steps))
+                def step(carry, i):
+                    buf, rng, done = carry
+                    logits, _ = model.apply(variables, buf, train=False)
+                    pos = base - 1 + i          # (B,) read position
+                    sel = jax.nn.one_hot(pos, t, dtype=logits.dtype)
+                    next_logits = jnp.einsum("btv,bt->bv", logits, sel)
+                    nxt, rng, done = sample(next_logits, rng, done)
+                    return (write_at(buf, nxt, pos + 1), rng, done), None
+
+                (buf, _, _), _ = lax.scan(step, (buf, rng, done0),
+                                          jnp.arange(num_steps))
                 return buf
 
         run = runners[key] = jax.jit(_run)
+        if len(runners) > _RUNNER_CACHE_MAX:
+            runners.popitem(last=False)
 
-    out = run(variables, buf, cache, jax.random.PRNGKey(seed))
+    lens_arg = None if (not ragged or lengths is None) \
+        else jnp.asarray(lengths)
+    out = run(variables, buf, cache, jax.random.PRNGKey(seed), lens_arg)
     return out[:, :p + num_steps]

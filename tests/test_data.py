@@ -95,3 +95,19 @@ def test_onehot_rejects_out_of_range():
     ds2 = Dataset({"label": np.array([0, 3])})
     with pytest.raises(ValueError):
         OneHotTransformer(3, "label", "oh").transform(ds2)
+
+
+def test_evaluator_kind_disambiguates_binary_tokens():
+    """(B, T) integer per-token targets over a binary vocabulary look like
+    one-hot rows to value-based inference; the explicit kind makes the
+    evaluator exact (ADVICE r3)."""
+    import distkeras_tpu as dk
+    # each row has exactly one 1 -> value-inference would argmax to (B,)
+    label = np.array([[0, 1, 0], [1, 0, 0]], np.int64)
+    pred = np.array([[0, 1, 0], [0, 0, 1]], np.int64)  # 4/6 tokens right
+    ds = dk.Dataset({"prediction": pred, "label": label})
+    ev = dk.AccuracyEvaluator("prediction", "label",
+                              prediction_kind="ids", label_kind="ids")
+    assert abs(ev.evaluate(ds) - 4 / 6) < 1e-9
+    with pytest.raises(ValueError, match="kind"):
+        dk.AccuracyEvaluator(prediction_kind="bogus")

@@ -383,3 +383,130 @@ def test_generate_cached_flash_impl(lm_ds):
     a = dk.generate_tokens(dense, v, prompt, 8, use_cache=True)
     b = dk.generate_tokens(flash, v, prompt, 8, use_cache=True)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.fixture(scope="module")
+def trained_lm(lm_ds):
+    """One trained counting LM shared by the decode-surface tests."""
+    t = dk.SingleTrainer(small_lm(), "adam",
+                         "sparse_categorical_crossentropy",
+                         features_col="features", label_col="label",
+                         num_epoch=8, batch_size=64, learning_rate=3e-3)
+    return t.train(lm_ds)
+
+
+def test_generate_num_steps_zero(trained_lm, lm_ds):
+    """num_steps=0 returns the prompt untouched on both strategies
+    (ADVICE r3: the cached runner used to corrupt the last token)."""
+    m = trained_lm
+    prompt = jnp.asarray(lm_ds["features"][:2, :8])
+    for uc in (None, False):
+        out = dk.generate_tokens(m, m.variables, prompt, 0, use_cache=uc)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+
+
+def test_generate_top_k_top_p(trained_lm, lm_ds):
+    """top_k=1 and a tiny top_p nucleus both collapse sampling to greedy
+    at ANY temperature; invalid filter values raise."""
+    m = trained_lm
+    prompt = jnp.asarray(lm_ds["features"][:2, :8])
+    greedy = dk.generate_tokens(m, m.variables, prompt, 8)
+    k1 = dk.generate_tokens(m, m.variables, prompt, 8, temperature=5.0,
+                            seed=3, top_k=1)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+    p_tiny = dk.generate_tokens(m, m.variables, prompt, 8, temperature=5.0,
+                                seed=3, top_p=1e-6)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(p_tiny))
+    # top_p=1.0 keeps the whole vocab: must equal unfiltered sampling
+    full = dk.generate_tokens(m, m.variables, prompt, 8, temperature=1.0,
+                              seed=3)
+    p_all = dk.generate_tokens(m, m.variables, prompt, 8, temperature=1.0,
+                               seed=3, top_p=1.0)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(p_all))
+    with pytest.raises(ValueError, match="top_k"):
+        dk.generate_tokens(m, m.variables, prompt, 4, top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        dk.generate_tokens(m, m.variables, prompt, 4, top_p=1.5)
+
+
+def test_generate_eos_freezes_rows(trained_lm, lm_ds):
+    """A row that emits eos_id freezes (masked continue); other rows keep
+    counting — verified on BOTH decode strategies."""
+    m = trained_lm
+    prompt = jnp.asarray(lm_ds["features"][:2, :8])
+    expected = (np.asarray(prompt[:, -1:]) + 1 + np.arange(12)[None, :]) \
+        % VOCAB
+    # eos = the token row 0 counts to at step 3; row 1 (offset by a
+    # different start) hits it at a different step or not at all
+    eos = int(expected[0, 3])
+    hit1 = np.nonzero(expected[1] == eos)[0]
+    for uc in (None, False):
+        out = np.asarray(dk.generate_tokens(m, m.variables, prompt, 12,
+                                            eos_id=eos, use_cache=uc))
+        assert (out[0, 8 + 3:] == eos).all()          # row 0 frozen at hit
+        np.testing.assert_array_equal(out[0, 8:8 + 4], expected[0, :4])
+        if len(hit1):                                  # row 1 independent
+            h = int(hit1[0])
+            np.testing.assert_array_equal(out[1, 8:8 + h + 1],
+                                          expected[1, :h + 1])
+            assert (out[1, 8 + h:] == eos).all()
+        else:
+            np.testing.assert_array_equal(out[1, 8:], expected[1])
+
+
+def test_generate_ragged_prompts(trained_lm, lm_ds):
+    """Right-padded ragged prompts: each row continues from ITS OWN last
+    token at its own positions (full-context strategy, exact training
+    forward); uniform prompt_lengths still take the cached path."""
+    m = trained_lm
+    full = np.asarray(lm_ds["features"][:2, :8])
+    lengths = np.array([8, 5], np.int32)
+    ragged = full.copy()
+    ragged[1, 5:] = 0  # right padding (value irrelevant: causal future)
+    out = np.asarray(dk.generate_tokens(
+        m, m.variables, jnp.asarray(ragged), 6, prompt_lengths=lengths))
+    assert out.shape == (2, 14)
+    exp0 = (full[0, 7] + 1 + np.arange(6)) % VOCAB
+    exp1 = (full[1, 4] + 1 + np.arange(6)) % VOCAB
+    np.testing.assert_array_equal(out[0, 8:14], exp0)
+    np.testing.assert_array_equal(out[1, 5:11], exp1)
+    # uniform lengths degenerate to the ordinary (cached) path
+    uni = dk.generate_tokens(m, m.variables, jnp.asarray(full), 6,
+                             prompt_lengths=np.full(2, 8, np.int32))
+    plain = dk.generate_tokens(m, m.variables, jnp.asarray(full), 6)
+    np.testing.assert_array_equal(np.asarray(uni), np.asarray(plain))
+    # ragged + forced cache is a contract violation
+    with pytest.raises(ValueError, match="ragged"):
+        dk.generate_tokens(m, m.variables, jnp.asarray(ragged), 6,
+                           prompt_lengths=lengths, use_cache=True)
+
+
+def test_generate_runner_cache_bounded(trained_lm, lm_ds, monkeypatch):
+    """The per-model compiled-runner cache is a bounded LRU (ADVICE r3:
+    it used to grow without bound across prompt shapes)."""
+    import distkeras_tpu.models.generation as gen
+    m = trained_lm
+    monkeypatch.setattr(gen, "_RUNNER_CACHE_MAX", 2)
+    m._generate_cache = None if not hasattr(m, "_generate_cache") else None
+    m._generate_cache = __import__("collections").OrderedDict()
+    for p in (4, 6, 8):
+        dk.generate_tokens(m, m.variables,
+                           jnp.asarray(lm_ds["features"][:2, :p]), 2)
+    assert len(m._generate_cache) == 2
+
+
+def test_generate_eos_not_cached_across_values(trained_lm, lm_ds):
+    """Two calls with different eos_id must not share a compiled runner
+    (the eos value is baked into the closure — review r4 repro)."""
+    m = trained_lm
+    prompt = jnp.asarray(lm_ds["features"][:1, :8])
+    expected = (np.asarray(prompt[:, -1:]) + 1 + np.arange(6)[None, :]) \
+        % VOCAB
+    e1, e2 = int(expected[0, 1]), int(expected[0, 3])
+    out1 = np.asarray(dk.generate_tokens(m, m.variables, prompt, 6,
+                                         eos_id=e1))
+    out2 = np.asarray(dk.generate_tokens(m, m.variables, prompt, 6,
+                                         eos_id=e2))
+    assert (out1[0, 8 + 1:] == e1).all(), out1
+    assert (out2[0, 8 + 3:] == e2).all(), out2
+    np.testing.assert_array_equal(out2[0, 8:8 + 3], expected[0, :3])
