@@ -21,6 +21,7 @@ from .trainers import (
     DynSGD,
     EAMSGD,
     EnsembleTrainer,
+    PipelineTrainer,
     SingleTrainer,
     SpmdTrainer,
     Trainer,

@@ -129,6 +129,11 @@ class Layer:
     #: silently compute the wrong thing on per-token input)
     time_mixing = False
 
+    #: layers whose training forward consumes randomness (Dropout) set
+    #: this True; contexts that cannot thread per-layer rng (the GPipe
+    #: stage schedule) refuse them instead of silently running eval-mode
+    rng_in_train = False
+
     def init_cache(self, batch: int, in_shape: tuple):
         """Decode-cache pytree for one-position-at-a-time generation
         (``models.generation``), or None for cache-free layers.
@@ -261,6 +266,8 @@ class Reshape(Layer):
 
 @register
 class Dropout(Layer):
+    rng_in_train = True
+
     def __init__(self, rate: float):
         self.rate = float(rate)
 

@@ -196,9 +196,11 @@ class MoEDense(Layer):
     re-jit (rebuild the predictor / trainer) after switching.
 
     The router load-balance aux loss is written to ``state["aux_loss"]``
-    each step — surfaced for custom loops / monitoring; the stock
-    trainers optimize the task loss only (document-level choice: the
-    reference's trainers have no auxiliary-loss concept either).
+    each step.  By default the stock trainers optimize the task loss only
+    (reference parity: its trainers have no auxiliary-loss concept); pass
+    ``aux_weight=...`` to any trainer to fold the load-balance losses
+    into the objective (``parallel.sync.make_local_step``) — the standard
+    mitigation for router/expert collapse in long MoE runs.
     """
 
     def __init__(self, num_experts: int, d_hidden: Optional[int] = None,

@@ -50,6 +50,48 @@ def stack_stage_params(stage_params: Sequence[Tree]) -> Tree:
         lambda *leaves: jnp.stack(leaves), *stage_params)
 
 
+def find_stage_segment(layers: Sequence, n_stages: int):
+    """Locate the homogeneous stage segment of a Sequential layer list.
+
+    Returns ``(start, group_len)`` such that
+    ``layers[start : start + n_stages*group_len]`` splits into
+    ``n_stages`` structurally identical groups (class + full config
+    equality, nested layers included) — e.g. ``zoo.gpt_lm``'s repeated
+    (Residual-attention, FF) blocks.  Picks the longest such span.
+    Raises when the stack has none (the model cannot pipeline over
+    ``n_stages`` stages).
+    """
+    def sig(lyr):
+        return (type(lyr).__name__, repr(lyr.config()))
+
+    sigs = [sig(l) for l in layers]
+    if n_stages == 1:
+        # degenerate mesh (pp=1): "any span" would trivially qualify and
+        # the longest-span rule would swallow embedding/head layers whose
+        # shapes don't pipeline.  Anchor on the model's actual repeated
+        # unit instead: locate it as a 2-stage split, then extend the run.
+        a, g = find_stage_segment(layers, 2)
+        end = a + 2 * g
+        while end + g <= len(layers) and sigs[end:end + g] == sigs[a:a + g]:
+            end += g
+        return a, end - a
+    best = None
+    for g in range(1, len(layers) // n_stages + 1):
+        span = n_stages * g
+        for a in range(0, len(layers) - span + 1):
+            if all(sigs[a + i * g + j] == sigs[a + j]
+                   for i in range(1, n_stages) for j in range(g)):
+                if best is None or span > best[0]:
+                    best = (span, a, g)
+    if best is None:
+        raise ValueError(
+            f"no contiguous run of {n_stages} structurally identical "
+            f"layer groups in this {len(layers)}-layer stack; pipeline "
+            f"parallelism needs homogeneous stages (e.g. zoo.gpt_lm with "
+            f"num_blocks divisible by the pp axis size)")
+    return best[1], best[2]
+
+
 def pipeline_apply(stage_fn: Callable, stage_params: Tree, x_mb, *,
                    axis_name: str = "pp"):
     """GPipe forward; call INSIDE ``shard_map``.

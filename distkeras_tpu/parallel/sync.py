@@ -94,9 +94,19 @@ def _shard_map_kw():
 # the local minibatch step (shared by sync engine and async PS workers)
 # ---------------------------------------------------------------------------
 
+def aux_losses(state: Tree) -> list:
+    """Collect every ``aux_loss`` leaf from a variables-state tree (each
+    ``MoEDense`` writes its router load-balance scalar there)."""
+    from jax.tree_util import DictKey, tree_flatten_with_path
+    return [leaf for path, leaf in tree_flatten_with_path(state)[0]
+            if path and isinstance(path[-1], DictKey)
+            and path[-1].key == "aux_loss"]
+
+
 def make_local_step(model, loss_fn: Callable,
                     optimizer: optax.GradientTransformation,
-                    compute_dtype=None, remat: bool = False):
+                    compute_dtype=None, remat: bool = False,
+                    aux_weight: float = 0.0):
     """One minibatch of local optimization as a pure scan-able function:
     ``step((variables, opt_state, rng), (x, y)) -> (carry', loss)``.
 
@@ -108,6 +118,11 @@ def make_local_step(model, loss_fn: Callable,
     are recomputed during the backward pass instead of living in HBM for
     the whole step — the standard FLOPs-for-memory trade for models whose
     activation footprint, not weights, is what OOMs.
+
+    ``aux_weight > 0`` folds ``aux_weight * Σ state['aux_loss']`` (the
+    MoE router load-balance losses) into the objective — the opt-in
+    mitigation for router/expert collapse in long MoE runs (ADVICE r3);
+    the default keeps the reference-parity task-loss-only behavior.
     """
 
     def forward(params, state, x, rng):
@@ -137,7 +152,12 @@ def make_local_step(model, loss_fn: Callable,
             fwd_params = cast_floats(params) if compute_dtype is not None \
                 else params
             out, new_state = forward(fwd_params, variables["state"], x, sub)
-            return loss_fn(out, y), new_state
+            loss_val = loss_fn(out, y)
+            if aux_weight:
+                aux = aux_losses(new_state)
+                if aux:
+                    loss_val = loss_val + aux_weight * sum(aux)
+            return loss_val, new_state
 
         (loss_val, new_state), grads = jax.value_and_grad(
             loss_of, has_aux=True)(variables["params"])
@@ -150,7 +170,7 @@ def make_local_step(model, loss_fn: Callable,
 
 
 def make_window_fn(model, loss_fn, optimizer, compute_dtype=None,
-                   remat: bool = False):
+                   remat: bool = False, aux_weight: float = 0.0):
     """jit-compiled window scan: ``(variables, opt_state, rng, xs, ys) ->
     (variables, opt_state, rng, losses)`` over the leading (steps) axis —
     the unit of work between two parameter-server interactions.
@@ -158,7 +178,8 @@ def make_window_fn(model, loss_fn, optimizer, compute_dtype=None,
     Carry buffers are donated: params/opt-state update in place in HBM
     (callers all rebind to the outputs, measured ~4% on ResNet-20).
     """
-    step = make_local_step(model, loss_fn, optimizer, compute_dtype, remat)
+    step = make_local_step(model, loss_fn, optimizer, compute_dtype, remat,
+                           aux_weight)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def run(variables, opt_state, rng, xs, ys):
@@ -293,7 +314,8 @@ class SyncEngine:
     def __init__(self, model, loss_fn: Callable, optimizer: optax.GradientTransformation,
                  algo: SyncAlgorithm, num_workers: int, window: int,
                  mesh: Optional[Mesh] = None, axis: str = "workers",
-                 compute_dtype=None, remat: bool = False):
+                 compute_dtype=None, remat: bool = False,
+                 aux_weight: float = 0.0):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -304,7 +326,7 @@ class SyncEngine:
         self.mesh = mesh if mesh is not None else make_mesh(num_workers, (axis,))
         self.compute_dtype = compute_dtype
         self._local_step = make_local_step(model, loss_fn, optimizer,
-                                           compute_dtype, remat)
+                                           compute_dtype, remat, aux_weight)
 
     # -- distributed epoch --------------------------------------------------
     def epoch_fn(self):

@@ -144,7 +144,8 @@ def _run_thread_workers(trainer, ps, server, mode, center, xs, ys, num_epoch,
     loss_fn, optimizer = trainer._resolve()
     window_fn = make_window_fn(trainer.model, loss_fn, optimizer,
                                compute_dtype=trainer.compute_dtype,
-                               remat=trainer.remat)
+                               remat=trainer.remat,
+                               aux_weight=trainer.aux_weight)
     worker_cls = _WORKER_CLASSES[mode]
     devices = jax.devices()
     workers = []
@@ -274,6 +275,7 @@ def _run_process_workers(trainer, ps, server, mode, center, xs, ys,
             "compute_dtype": str(trainer.compute_dtype)
             if trainer.compute_dtype is not None else None,
             "remat": bool(trainer.remat),
+            "aux_weight": float(trainer.aux_weight),
             "mode": mode,
             "alpha": float(getattr(trainer, "alpha", 0.0)),
             "worker_id": k, "host": "127.0.0.1", "port": server.port,

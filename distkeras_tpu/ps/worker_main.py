@@ -54,11 +54,13 @@ def run_spec(spec_path: str) -> None:
     shim = Trainer(model, spec["worker_optimizer"], spec["loss"],
                    learning_rate=spec["learning_rate"],
                    compute_dtype=spec.get("compute_dtype"),
-                   remat=bool(spec.get("remat", False)))
+                   remat=bool(spec.get("remat", False)),
+                   aux_weight=float(spec.get("aux_weight", 0.0)))
     loss_fn, optimizer = shim._resolve()
     window_fn = make_window_fn(model, loss_fn, optimizer,
                                compute_dtype=shim.compute_dtype,
-                               remat=shim.remat)
+                               remat=shim.remat,
+                               aux_weight=shim.aux_weight)
 
     import jax
     worker_cls = _WORKER_CLASSES[spec["mode"]]

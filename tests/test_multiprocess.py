@@ -123,6 +123,10 @@ def test_jax_distributed_two_process_smoke(tmp_path):
     addr = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)
+    # accelerator-tunnel interpreter hooks (sitecustomize) may initialize
+    # the XLA backend at import, which jax.distributed.initialize forbids;
+    # strip their trigger so the child is a clean CPU process
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     procs = [subprocess.Popen([sys.executable, str(script), addr, str(k)],
                               env=env, cwd=ROOT, stdout=subprocess.PIPE,
                               stderr=subprocess.STDOUT)
@@ -134,3 +138,27 @@ def test_jax_distributed_two_process_smoke(tmp_path):
     for k, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {k} failed:\n{out}"
         assert f"DIST_OK {k}" in out
+
+
+def test_package_import_keeps_backend_uninitialized(tmp_path):
+    """Importing distkeras_tpu must NOT initialize the XLA backend: the
+    multihost contract is `import package; multihost.initialize()` as the
+    program's first JAX act (a module-level jnp scalar anywhere in the
+    package broke this once — caught here)."""
+    script = tmp_path / "imp.py"
+    script.write_text(textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {ROOT!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from jax._src import xla_bridge
+        import distkeras_tpu
+        assert not xla_bridge._backends, "package import initialized XLA"
+        print("IMPORT_CLEAN")
+    """))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run([sys.executable, str(script)], env=env,
+                         capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "IMPORT_CLEAN" in out.stdout
