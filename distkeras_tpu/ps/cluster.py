@@ -1,0 +1,128 @@
+"""Async parameter-server training across a ``jax.distributed`` cluster —
+the multi-HOST deployment shape (SURVEY.md §2 comm backend: host PS over
+DCN; VERDICT r3 missing #3).
+
+The reference runs its ``SocketParameterServer`` on the Spark driver and
+workers on executors spread over machines.  The equivalent here: after
+``parallel.multihost.initialize()`` forms the process group, process 0
+hosts the TCP parameter server and EVERY process (0 included) runs one
+async worker on its own devices, pulling/committing over TCP — localhost
+within a host, DCN across hosts.  Same ``ps.servers`` / ``ps.workers``
+machinery the single-process ``mode="async"`` path uses; this module only
+adds the cross-process choreography:
+
+    multihost.initialize(...)                 # or env-driven on a pod
+    trainer = DOWNPOUR(model, num_workers=jax.process_count(), ...)
+    model = run_cluster_async_training(trainer, dataset,
+                                       ps_address=("host0", 7077))
+
+Every process returns the same final model (the trained center is
+broadcast from process 0); ``trainer.ps_stats`` is populated on process 0.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..parallel.sync import make_window_fn
+from ..utils import serde
+from .runner import _WORKER_CLASSES
+from .servers import SocketParameterServer
+
+
+def run_cluster_async_training(trainer, dataset,
+                               ps_address: Tuple[str, int],
+                               fault_injector=None):
+    """Drive async-PS training with one worker per ``jax.distributed``
+    process and the PS on process 0.
+
+    ``trainer``: an async-capable DistributedTrainer subclass with
+    ``num_workers == jax.process_count()``.  ``dataset``: the FULL
+    dataset, identical on every process (each process trains partition
+    ``jax.process_index()`` — the reference's executor-gets-its-partition
+    contract).  ``ps_address``: (host, port) of process 0's server,
+    reachable from every process.
+    """
+    from jax.experimental import multihost_utils
+
+    pid = jax.process_index()
+    nproc = jax.process_count()
+    if trainer.num_workers != nproc:
+        raise ValueError(
+            f"trainer.num_workers ({trainer.num_workers}) must equal the "
+            f"cluster's process count ({nproc}): one async worker per "
+            f"process")
+    mode = getattr(trainer, "_async_mode", "pull_commit")
+    worker_cls = _WORKER_CLASSES[mode]
+    loss_fn, optimizer = trainer._resolve()
+    window_fn = make_window_fn(trainer.model, loss_fn, optimizer,
+                               compute_dtype=trainer.compute_dtype,
+                               remat=trainer.remat,
+                               aux_weight=trainer.aux_weight)
+
+    # deterministic staging on every process; this one trains slice pid
+    xs, ys, _ = trainer._stage_data(dataset, trainer.communication_window)
+    center = jax.tree_util.tree_map(np.asarray,
+                                    trainer.model.init(trainer.seed))
+
+    host, port = ps_address
+    server = None
+    ps = None
+    if pid == 0:
+        ps = trainer._ps_factory()(center, num_workers=nproc)
+        server = SocketParameterServer(ps, host="0.0.0.0", port=int(port),
+                                       fault_injector=fault_injector)
+        server.start()
+    # workers must not race the server's bind
+    multihost_utils.sync_global_devices("dkps_server_up")
+
+    try:
+        kw = {}
+        if worker_cls is _WORKER_CLASSES["elastic"]:
+            kw["alpha"] = trainer.alpha
+        worker = worker_cls(
+            pid, window_fn, center,
+            optimizer.init(center["params"]),
+            jax.random.PRNGKey(trainer.seed + 1 + pid),
+            host if pid != 0 else "127.0.0.1", int(port),
+            trainer.num_epoch, **kw)
+        worker.set_data(xs[pid], ys[pid])
+        worker.run()  # synchronously IN this process (it owns the devices)
+        if worker.error is not None:
+            raise worker.error
+        trainer.history = [l for l in worker.losses]
+        # all commits in before process 0 reads the center
+        multihost_utils.sync_global_devices("dkps_workers_done")
+    finally:
+        if server is not None:
+            # barrier above guarantees every worker finished its protocol
+            multihost_utils.sync_global_devices("dkps_stop")
+            server.stop()
+        else:
+            multihost_utils.sync_global_devices("dkps_stop")
+
+    if pid == 0:
+        trainer.ps_stats = {
+            "num_updates": ps.num_updates,
+            "commits_by_worker": dict(ps.commits_by_worker),
+            "staleness_seen": list(getattr(ps, "staleness_seen", []))}
+        final = ps.get_model()
+        blob = np.frombuffer(serde.tree_to_bytes(final), np.uint8)
+        size = np.asarray([blob.size], np.int64)
+    else:
+        final = None
+        size = np.asarray([0], np.int64)
+
+    # broadcast the trained center to every process (variable-size blob:
+    # size first, then the padded payload)
+    size = int(multihost_utils.broadcast_one_to_all(size)[0])
+    if pid == 0:
+        payload = blob
+    else:
+        payload = np.zeros((size,), np.uint8)
+    payload = multihost_utils.broadcast_one_to_all(payload)
+    final = serde.tree_from_bytes(payload.tobytes())
+    return trainer._finish(final)
