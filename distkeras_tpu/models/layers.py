@@ -388,6 +388,37 @@ class _Pool2D(Layer):
 
 
 @register
+class SpaceToDepth(Layer):
+    """(H, W, C) → (H/b, W/b, C·b²): each b×b spatial patch becomes one
+    pixel's channel stack.  The standard TPU stem transform: a conv on
+    tiny-channel inputs (RGB C=3) underfills the MXU's 128 lanes, so the
+    stem patchifies first and feeds a stride-1 conv at C·b² channels —
+    same downsampling, MXU-shaped contraction (``zoo.resnet50(stem=
+    "s2d")``; SURVEY.md §6 perf north star, VERDICT r3 weak #2)."""
+
+    def __init__(self, block_size: int):
+        self.block_size = int(block_size)
+
+    def out_shape(self, in_shape):
+        h, w, c = in_shape
+        b = self.block_size
+        if h % b or w % b:
+            raise ValueError(f"spatial extent ({h}, {w}) not divisible by "
+                             f"block_size {b}")
+        return (h // b, w // b, c * b * b)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        n, h, w, c = x.shape
+        b = self.block_size
+        x = x.reshape(n, h // b, b, w // b, b, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5)  # (N, H/b, W/b, b, b, C)
+        return x.reshape(n, h // b, w // b, b * b * c), state
+
+    def get_config(self):
+        return {"block_size": self.block_size}
+
+
+@register
 class MaxPool2D(_Pool2D):
     def apply(self, params, state, x, *, train=False, rng=None):
         (pt, pb), (pl, pr) = self._pads(x.shape[1], x.shape[2])

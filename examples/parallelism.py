@@ -33,10 +33,7 @@ import numpy as np
 import distkeras_tpu as dk
 from distkeras_tpu.data.transformers import OneHotTransformer
 from distkeras_tpu.models.layers import Dense, Sequential
-from distkeras_tpu.ops.moe import init_moe_params, switch_moe_sharded
 from distkeras_tpu.parallel.mesh import make_mesh
-from distkeras_tpu.parallel.pipeline import (pipeline_apply_sharded,
-                                             stack_stage_params)
 from distkeras_tpu.parallel.ring import ring_attention_sharded
 
 
@@ -73,26 +70,38 @@ def main():
     print(f"sp    ring attention, T={q.shape[1]} over {n} shards: "
           f"out {tuple(out.shape)}")
 
-    # -- pp: GPipe pipeline -----------------------------------------------
-    pp_mesh = make_mesh(n, ("pp",))
-    d = 32
-    stages = stack_stage_params([
-        {"w": jnp.asarray(rng.normal(0, 0.3, (d, d)), jnp.float32),
-         "b": jnp.zeros(d, jnp.float32)} for _ in range(n)])
-    x = jnp.asarray(rng.normal(size=(4 * n, d)), jnp.float32)
-    out = pipeline_apply_sharded(
-        pp_mesh, lambda s, h: h + jnp.tanh(h @ s["w"] + s["b"]), stages, x,
-        num_microbatches=n)
-    print(f"pp    GPipe, {n} stages x {n} microbatches: "
-          f"out {tuple(out.shape)}")
+    # -- pp: GPipe pipeline through the public PipelineTrainer -------------
+    lm_ds = dk.datasets.load_lm_corpus(n_train=64, seq_len=32,
+                                       vocab_size=17)[0]
+    pp_shape = {"pp": n // 2, "dp": 2} if n % 2 == 0 and n >= 4 \
+        else {"pp": n}
+    pt = dk.PipelineTrainer(
+        dk.zoo.gpt_lm(vocab_size=17, dim=32, num_heads=2,
+                      num_blocks=max(2, pp_shape["pp"]), seq_len=32),
+        "adam", "sparse_categorical_crossentropy", mesh_shape=pp_shape,
+        num_microbatches=4, features_col="features", label_col="label",
+        num_epoch=2, batch_size=16, learning_rate=1e-3)
+    pt.train(lm_ds)
+    print(f"pp    PipelineTrainer(gpt_lm) over {pp_shape}: "
+          f"loss {pt.get_averaged_history()[-1]:.3f}")
 
-    # -- ep: switch-MoE ----------------------------------------------------
+    # -- ep: gpt_lm with ep-sharded switch-MoE FF blocks -------------------
+    from distkeras_tpu.ops.moe import MoEDense
     ep_mesh = make_mesh(n, ("ep",))
-    moe = init_moe_params(0, 2 * n, d, 4 * d)
-    tokens = jnp.asarray(rng.normal(size=(16 * n, d)), jnp.float32)
-    out, aux = switch_moe_sharded(ep_mesh, moe, tokens)
-    print(f"ep    switch-MoE, {2 * n} experts over {n} devices: "
-          f"out {tuple(out.shape)}, aux {float(aux):.3f}")
+    moe_model = dk.zoo.gpt_lm(vocab_size=17, dim=32, num_heads=2,
+                              num_blocks=1, seq_len=32,
+                              moe_experts=2 * n)
+    for lyr in moe_model.iter_layers():
+        if isinstance(lyr, MoEDense):
+            lyr.mesh = ep_mesh
+    et = dk.SingleTrainer(moe_model, "adam",
+                          "sparse_categorical_crossentropy",
+                          features_col="features", label_col="label",
+                          num_epoch=2, batch_size=16, learning_rate=1e-3,
+                          aux_weight=0.01)
+    et.train(lm_ds)
+    print(f"ep    gpt_lm({2 * n} experts) over {n} devices "
+          f"(aux folded): loss {et.get_averaged_history()[-1]:.3f}")
 
 
 if __name__ == "__main__":

@@ -53,6 +53,9 @@ def main():
     ap.add_argument("--width", type=int, default=16,
                     help="ResNet-20 base width (16 = the standard model)")
     ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--stem", default="conv7", choices=["conv7", "s2d"],
+                    help="ResNet-50 stem: classic conv7 or the TPU "
+                         "space-to-depth rewrite")
     ap.add_argument("--classes", type=int, default=10)
     ap.add_argument("--epochs", type=int, default=6,
                     help="timed epochs (after 2 warmup)")
@@ -80,8 +83,8 @@ def main():
     else:
         if args.width != 16:
             ap.error("--width applies to resnet20 only")
-        model = zoo.resnet50(num_classes=k, input_size=s)
-        label = f"resnet50({s}px)"
+        model = zoo.resnet50(num_classes=k, input_size=s, stem=args.stem)
+        label = f"resnet50({s}px, stem={args.stem})"
     xs = rng.random((n, s, s, 3), dtype=np.float32)
     ys = np.eye(k, dtype=np.float32)[rng.integers(0, k, size=n)]
 

@@ -150,3 +150,52 @@ def test_custom_activation_serde_refused():
     assert d.get_config()["activation"] == "relu"
     with pytest.raises(ValueError, match="cannot serialize"):
         Dense(3, activation=lambda x: x * 2).get_config()
+
+
+def test_space_to_depth_layout_and_grads():
+    """SpaceToDepth: each bxb patch becomes one output pixel's channel
+    stack, invertible, shape-checked, and differentiable (it's pure
+    reshape/transpose)."""
+    from distkeras_tpu.models.layers import SpaceToDepth
+    import jax
+    s2d = SpaceToDepth(2)
+    x = jnp.arange(2 * 4 * 4 * 3, dtype=jnp.float32).reshape(2, 4, 4, 3)
+    y, _ = s2d.apply({}, {}, x)
+    assert y.shape == (2, 2, 2, 12)
+    # output pixel (0,0) stacks input patch rows (0,0),(0,1),(1,0),(1,1)
+    np.testing.assert_array_equal(
+        np.asarray(y[0, 0, 0]),
+        np.concatenate([np.asarray(x[0, i, j]) for i in (0, 1)
+                        for j in (0, 1)]))
+    assert s2d.out_shape((8, 8, 3)) == (4, 4, 12)
+    with pytest.raises(ValueError, match="divisible"):
+        s2d.out_shape((5, 4, 3))
+    g = jax.grad(lambda x: jnp.sum(s2d.apply({}, {}, x)[0] ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g), 2 * np.asarray(x))
+
+
+def test_resnet50_s2d_stem_trains():
+    """zoo.resnet50(stem='s2d'): same output surface as the conv7 stem,
+    serde roundtrip included, and a few SGD steps reduce the loss."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.utils import serde
+    m = dk.zoo.resnet50(num_classes=5, input_size=32, stem="s2d")
+    rng = np.random.default_rng(0)
+    ds = dk.Dataset({
+        "features": rng.random((128, 32, 32, 3)).astype(np.float32),
+        "label_onehot": np.eye(5, dtype=np.float32)[
+            rng.integers(0, 5, 128)]})
+    t = dk.SingleTrainer(m, "sgd", "categorical_crossentropy",
+                         label_col="label_onehot", num_epoch=5,
+                         batch_size=32, learning_rate=0.005)
+    m = t.train(ds)
+    h = t.get_averaged_history()
+    assert h[-1] < h[0], h
+    blob = serde.serialize_model(m, m.variables)
+    m2, v2 = serde.deserialize_model(blob)
+    x = jnp.asarray(ds["features"][:4])
+    np.testing.assert_allclose(
+        np.asarray(m.apply(m.variables, x)[0]),
+        np.asarray(m2.apply(v2, x)[0]), rtol=1e-5)
+    with pytest.raises(ValueError, match="stem"):
+        dk.zoo.resnet50(stem="bogus")
