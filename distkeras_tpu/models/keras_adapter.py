@@ -18,7 +18,7 @@ accepts it unchanged.  Under the hood ``apply`` is
 from __future__ import annotations
 
 import os
-from typing import Any, Optional, Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 

@@ -9,10 +9,9 @@ through jit-compiled steps.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence
+from typing import Optional, Sequence
 
 import jax
-import jax.numpy as jnp
 
 from .layers import Layer, Sequential, layer_from_config
 

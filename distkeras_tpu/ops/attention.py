@@ -14,7 +14,6 @@ framework ships a standard MXU-friendly attention stack:
 from __future__ import annotations
 
 import math
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

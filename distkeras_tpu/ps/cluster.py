@@ -22,7 +22,7 @@ broadcast from process 0); ``trainer.ps_stats`` is populated on process 0.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import numpy as np

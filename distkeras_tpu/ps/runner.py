@@ -21,7 +21,6 @@ import os
 import subprocess
 import sys
 import tempfile
-from typing import Optional
 
 import jax
 import numpy as np

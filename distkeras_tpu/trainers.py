@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import time
 import warnings
-from typing import Any, Optional, Sequence
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -1053,7 +1053,14 @@ class PipelineTrainer(Trainer):
                                          variables["params"]["post"])},
                 "state": variables["state"],
             }
-            opt_state = place(jax.device_put, opt_state, opt_shardings)
+            # mesh-spanning shardings (stage moments inherit P('pp') via
+            # zeros_like) re-apply as captured; scalar leaves (optax step
+            # counts) were single-device uncommitted on the fresh path —
+            # commit them replicated so no mixed-device-set conflict
+            opt_state = place(
+                lambda x, sh: jax.device_put(
+                    x, sh if len(sh.device_set) > 1 else rep),
+                opt_state, opt_shardings)
             rng = jax.device_put(rng, rep)
 
         samples = int(xs.shape[0]) * self.batch_size
