@@ -122,13 +122,15 @@ def build(cfg: RunConfig):
         import shutil
         import tempfile
         from .data.streaming import ShardedFileDataset
-        from .trainers import DistributedTrainer, SingleTrainer
-        if not issubclass(trainer_cls, (SingleTrainer, DistributedTrainer)):
+        from .trainers import (DistributedTrainer, SingleTrainer,
+                               SpmdTrainer)
+        if not issubclass(trainer_cls, (SingleTrainer, DistributedTrainer,
+                                        SpmdTrainer)):
             # fail at build time with a clear message, not mid-train
             raise ValueError(
                 f"streaming: trainer {cfg.trainer!r} has no "
-                f"ShardedFileDataset path (supported: SingleTrainer and "
-                f"the distributed trainer family)")
+                f"ShardedFileDataset path (supported: SingleTrainer, "
+                f"SpmdTrainer and the distributed trainer family)")
         if isinstance(cfg.streaming, int) and \
                 not isinstance(cfg.streaming, bool):
             rows = cfg.streaming

@@ -724,7 +724,9 @@ class SpmdTrainer(Trainer):
     too large to replicate train.
 
     ``mesh_shape``: e.g. ``{"dp": 2, "mp": 4}`` (defaults to all devices
-    on dp).
+    on dp).  Also accepts a disk-backed ``ShardedFileDataset``: epochs
+    then stream window-by-window with dp-sharded batches and mp-sharded
+    params (``_train_stream``).
     """
 
     def __init__(self, keras_model: Model, worker_optimizer="sgd",
@@ -771,8 +773,74 @@ class SpmdTrainer(Trainer):
             self._run_cache = (key, run, optimizer, mesh, dp)
         return self._run_cache[1:]
 
-    def _train(self, dataset: Dataset, shuffle: bool) -> Model:
+    def _train_stream(self, source, shuffle: bool) -> Model:
+        """Disk-streaming GSPMD epochs: windows assemble on the host while
+        the mesh trains the previous one; batches land batch-sharded over
+        dp, params stay mp-sharded — ImageNet-scale inputs for models too
+        large to replicate (SURVEY.md §7 hard part 6 × GSPMD)."""
+        from .data.streaming import window_batches
         from .parallel import spmd
+        run, optimizer, mesh, dp = self._window_run()
+        bs = self.batch_size
+        steps = source.steps_per_epoch(bs)
+        if steps == 0:
+            raise ValueError(f"batch_size {bs} exceeds dataset rows "
+                             f"{source.num_rows}")
+        w = max(1, min(int(SingleTrainer.stream_window), steps))
+        n_windows = steps // w
+
+        variables = self.model.init(self.seed)
+        specs = spmd.infer_param_specs(variables["params"], mesh)
+        variables = {"params": spmd.place(variables["params"], mesh, specs),
+                     "state": spmd.replicate(variables["state"], mesh)}
+        self.sharding_report = spmd.sharding_report(variables["params"])
+        opt_state = optimizer.init(variables["params"])
+        rng = jax.device_put(jax.random.PRNGKey(self.seed + 1),
+                             jax.sharding.NamedSharding(
+                                 mesh, jax.sharding.PartitionSpec()))
+        ckpt = self._ckpt_manager()
+        opt_shardings = jax.tree_util.tree_map(lambda x: x.sharding,
+                                               opt_state)
+        (variables, opt_state, rng), start_epoch = self._maybe_restore(
+            ckpt, (variables, opt_state, rng))
+        if start_epoch:  # restored host arrays: re-apply GSPMD placement
+            variables = {
+                "params": spmd.place(variables["params"], mesh, specs),
+                "state": spmd.replicate(variables["state"], mesh)}
+            opt_state = jax.tree_util.tree_map(
+                jax.device_put, opt_state, opt_shardings)
+            rng = jax.device_put(rng, jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec()))
+
+        bsh = spmd.batch_sharding(mesh, dp, batch_dim=1)  # (w, batch, ...)
+        cols = [self.features_col, self.label_col]
+        samples = n_windows * w * bs
+        pipe = _EpochPipeline(self, samples)
+        for epoch in range(start_epoch, self.num_epoch):
+            seed = (self.seed + 1000 + epoch) if shuffle else None
+            it = window_batches(source.batches(cols, bs, seed=seed), w)
+            losses = []
+            try:
+                for _ in range(n_windows):
+                    wx, wy = next(it)
+                    variables, opt_state, rng, l = run(
+                        variables, opt_state, rng,
+                        jax.device_put(wx, bsh), jax.device_put(wy, bsh))
+                    losses.append(l)
+            finally:
+                it.close()
+            pipe.push(epoch, jnp.concatenate(losses))
+            if ckpt is not None:
+                ckpt.save(epoch, (variables, opt_state, rng),
+                          {"epoch": epoch})
+        pipe.flush()
+        return self._finish(variables)
+
+    def _train(self, dataset: Dataset, shuffle: bool) -> Model:
+        from .data.streaming import ShardedFileDataset
+        from .parallel import spmd
+        if isinstance(dataset, ShardedFileDataset):
+            return self._train_stream(dataset, shuffle)
         if shuffle:
             dataset = dataset.shuffle(self.seed)
         run, optimizer, mesh, dp = self._window_run()
