@@ -107,6 +107,10 @@ class MultiHeadAttention(Layer):
         self.mesh = None        # runtime attachment → ring attention
         self.ring_axis = "sp"
         self.batch_axis = None  # optional dp axis for dp×sp composition
+        #: ring hop compute: None → follow ``impl`` (flash layers ring
+        #: with the fused kernel per hop, O(T_loc·D) memory); or set
+        #: "blockwise"/"flash" explicitly
+        self.ring_impl = None
 
     def init(self, rng, in_shape):
         t, d = in_shape
@@ -131,10 +135,18 @@ class MultiHeadAttention(Layer):
         v = v.reshape(b, t, h, dh)
         if self.mesh is not None:
             from ..parallel.ring import ring_attention_sharded
+            from ..ops.pallas_attention import _HAS_PLTPU
+            # flash layers ring with the fused kernel per hop; fall back
+            # to the einsum hops on builds without the pallas TPU module
+            # (the ring itself runs anywhere)
+            ring_impl = self.ring_impl or (
+                "flash" if self.impl == "flash" and _HAS_PLTPU
+                else "blockwise")
             o = ring_attention_sharded(self.mesh, q, k, v,
                                        axis=self.ring_axis,
                                        batch_axis=self.batch_axis,
-                                       causal=self.causal)
+                                       causal=self.causal,
+                                       impl=ring_impl)
         elif self.impl == "flash":
             o = _flash_with_blocking(q, k, v, self.causal, t)
         else:

@@ -348,15 +348,21 @@ def _vjp_fwd(q, k, v, causal, block_q, block_k):
     return _from_bh(out, b, h), (q, k, v, out, lse)
 
 
-def _vjp_bwd(causal, block_q, block_k, res, g):
+def _bwd_impl(causal, block_q, block_k, res, g_out, g_lse=None):
+    """Shared backward: ``g_lse`` (the lse cotangent, (B, H, T)) folds
+    into the softmax-grad correction term — ∂lse_i/∂s_ij = P_ij lands
+    exactly where D_i enters dS = P∘(dP − D), so ``dvec − g_lse`` covers
+    it with the kernels unchanged."""
     q, k, v, out_bh, lse = res
     b, t, h, dh = q.shape
     bq, bk = _blocks(t, block_q, block_k, dh)
     scale = 1.0 / math.sqrt(dh)
-    do = _to_bh(g.astype(q.dtype))
+    do = _to_bh(g_out.astype(q.dtype))
     # D_i = rowsum(dO_i ∘ O_i) — the softmax-grad correction term (f32)
     dvec = jnp.sum(do.astype(jnp.float32) * out_bh.astype(jnp.float32),
                    axis=-1)[:, None, :]
+    if g_lse is not None:
+        dvec = dvec - g_lse.astype(jnp.float32).reshape(b * h, 1, t)
     dq, dk, dv = _flash_bwd_raw(_to_bh(q), _to_bh(k), _to_bh(v), do, lse,
                                 dvec, causal=causal, bq=bq, bk=bk,
                                 scale=scale)
@@ -365,4 +371,47 @@ def _vjp_bwd(causal, block_q, block_k, res, g):
             _from_bh(dv, b, h).astype(v.dtype))
 
 
+def _vjp_bwd(causal, block_q, block_k, res, g):
+    return _bwd_impl(causal, block_q, block_k, res, g)
+
+
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# flash attention WITH the logsumexp exposed (ring / cross-block merging)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_lse(q, k, v, causal: bool = False, block_q=None,
+                        block_k=None):
+    """Like :func:`flash_attention` but also returns the per-row
+    logsumexp ``lse`` (B, H, T) in f32 — the statistic that lets callers
+    merge attention over key/value BLOCKS exactly:
+
+        lse_tot = logaddexp(lse_a, lse_b)
+        out_tot = out_a·exp(lse_a − lse_tot) + out_b·exp(lse_b − lse_tot)
+
+    (``parallel.ring`` uses this to run the fused kernel per ring hop.)
+    Differentiable in BOTH outputs: an ``lse`` cotangent folds into the
+    backward as ``dvec − g_lse`` — since ∂lse_i/∂s_ij = P_ij, the extra
+    term lands exactly where the softmax-grad correction D_i already
+    enters dS = P∘(dP − D), so the kernels are reused unchanged.
+    """
+    (out, lse), _ = _vjp_lse_fwd(q, k, v, causal, block_q, block_k)
+    return out, lse
+
+
+def _vjp_lse_fwd(q, k, v, causal, block_q, block_k):
+    out, res = _vjp_fwd(q, k, v, causal, block_q, block_k)
+    b, t, h, dh = q.shape
+    lse = res[4].reshape(b, h, t)  # (BH, 1, T) -> (B, H, T), f32
+    return (out, lse), res
+
+
+def _vjp_lse_bwd(causal, block_q, block_k, res, cts):
+    g_out, g_lse = cts
+    return _bwd_impl(causal, block_q, block_k, res, g_out, g_lse)
+
+
+flash_attention_lse.defvjp(_vjp_lse_fwd, _vjp_lse_bwd)

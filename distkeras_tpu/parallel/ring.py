@@ -29,12 +29,24 @@ from .sync import _shard_map_kw
 _NEG = -1e30  # finite -inf stand-in: keeps the online-softmax exp() NaN-free
 
 
-def ring_attention(q, k, v, axis_name: str, *, causal: bool = False):
+def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
+                   impl: str = "blockwise"):
     """Blockwise ring attention; call INSIDE ``shard_map``.
 
     q/k/v: per-device sequence shards (B, T_loc, H, Dh), sharded on T over
     ``axis_name``.  Returns the attention output shard (B, T_loc, H, Dh).
-    """
+
+    ``impl="flash"`` runs the fused Pallas kernel per ring hop and merges
+    hops via the exposed logsumexp (``ops.pallas_attention.
+    flash_attention_lse``): per-hop memory drops from O(T_loc²) score
+    blocks to O(T_loc·D), so the per-chip shard length is HBM-bound like
+    single-chip flash — the sp × flash composition for genuinely long
+    context.  ``"blockwise"`` keeps the einsum formulation (exact,
+    runs anywhere)."""
+    if impl == "flash":
+        return _ring_attention_flash(q, k, v, axis_name, causal=causal)
+    if impl != "blockwise":
+        raise ValueError(f"impl must be blockwise|flash, got {impl!r}")
     p_size = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, t_loc, h, dh = q.shape
@@ -75,18 +87,55 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False):
     return out.astype(q.dtype)
 
 
+def _ring_attention_flash(q, k, v, axis_name: str, *, causal: bool):
+    """Flash-kernel ring: hop 0 is the home (diagonal) block — the causal
+    kernel when masking; later hops are fully-visible or fully-masked
+    whole blocks (never diagonal), so they run the unmasked kernel and a
+    per-hop scalar folds invisible blocks out through the lse merge
+    (exp(_NEG − lse) ≡ 0 — no NaNs, exact zero weight)."""
+    from ..ops.pallas_attention import flash_attention_lse
+
+    p_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+
+    def merge(o_acc, lse_acc, o_i, lse_i):
+        lse_new = jnp.logaddexp(lse_acc, lse_i)
+        w_a = jnp.exp(lse_acc - lse_new).transpose(0, 2, 1)[..., None]
+        w_i = jnp.exp(lse_i - lse_new).transpose(0, 2, 1)[..., None]
+        return (o_acc.astype(jnp.float32) * w_a
+                + o_i.astype(jnp.float32) * w_i), lse_new
+
+    # hop 0: the home block (diagonal when causal)
+    o_acc, lse_acc = flash_attention_lse(q, k, v, causal)
+    o_acc = o_acc.astype(jnp.float32)
+    kb, vb = k, v
+    for i in range(1, p_size):  # p_size is static: unrolled schedule
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        o_i, lse_i = flash_attention_lse(q, kb, vb, False)
+        if causal:
+            src = (my_idx - i) % p_size
+            # whole-block visibility: block src strictly before my shard
+            lse_i = jnp.where(src < my_idx, lse_i, _NEG)
+        o_acc, lse_acc = merge(o_acc, lse_acc, o_i, lse_i)
+    return o_acc.astype(q.dtype)
+
+
 def ring_attention_sharded(mesh: Mesh, q, k, v, *, axis: str = "sp",
-                           batch_axis: str = None, causal: bool = False):
+                           batch_axis: str = None, causal: bool = False,
+                           impl: str = "blockwise"):
     """Whole-array entry point: shards q/k/v on the sequence (T) axis over
     ``mesh[axis]`` and runs ring attention.  q/k/v: (B, T, H, Dh).
 
     ``batch_axis`` additionally shards the batch dimension over another
     mesh axis (dp×sp composition: each dp replica runs its own sequence
     ring over its batch shard — the K/V rotation stays within the sp
-    axis, so rings never cross data-parallel replicas)."""
+    axis, so rings never cross data-parallel replicas).  ``impl``: see
+    :func:`ring_attention` (``"flash"`` = fused Pallas kernel per hop)."""
     spec = P(batch_axis, axis)
     fn = shard_map(
-        partial(ring_attention, axis_name=axis, causal=causal),
+        partial(ring_attention, axis_name=axis, causal=causal, impl=impl),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
