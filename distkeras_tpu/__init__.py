@@ -11,7 +11,7 @@ __version__ = "0.1.0"
 
 from . import data, models, ops, parallel, utils
 from .data import Dataset
-from .models import Model, Sequential, generate_tokens
+from .models import Model, Sequential, generate_beam, generate_tokens
 from .trainers import (
     ADAG,
     AEASGD,

@@ -4,4 +4,4 @@ from .layers import (
     register, layer_from_config, LAYER_REGISTRY,
 )
 from .model import Model, num_params
-from .generation import generate_tokens
+from .generation import generate_beam, generate_tokens

@@ -77,6 +77,34 @@ def _model_cache(model, batch):
     return cache if jax.tree_util.tree_leaves(cache) else None
 
 
+def _write_at(buf, tok, pos, t):
+    """Write ``tok`` (B,) into ``buf[:, pos]``; ``pos`` scalar or (B,)
+    (one-hot update — no gather/scatter shape surprises on TPU)."""
+    w = jax.nn.one_hot(pos, t, dtype=jnp.int32)
+    if w.ndim == 1:
+        w = w[None, :]
+    return buf * (1 - w) + tok[:, None] * w
+
+
+def _cached_runner(model, key):
+    """Per-model bounded-LRU of compiled decode runners: returns
+    ``(runners, run_or_None)`` with the LRU order already refreshed."""
+    runners = getattr(model, "_generate_cache", None)
+    if runners is None:
+        runners = model._generate_cache = OrderedDict()
+    run = runners.get(key)
+    if run is not None:
+        runners.move_to_end(key)
+    return runners, run
+
+
+def _cache_runner(runners, key, run):
+    runners[key] = run
+    if len(runners) > _RUNNER_CACHE_MAX:
+        runners.popitem(last=False)
+    return run
+
+
 def _filter_logits(logits, top_k, top_p):
     """top-k / nucleus (top-p) filtering; composable, batch-wise."""
     if top_k is not None:
@@ -185,12 +213,7 @@ def generate_tokens(model, variables, prompt, num_steps: int,
            None if top_k is None else int(top_k),
            None if top_p is None else float(top_p),
            None if eos_id is None else int(eos_id), ragged)
-    runners = getattr(model, "_generate_cache", None)
-    if runners is None:
-        runners = model._generate_cache = OrderedDict()
-    run = runners.get(key)
-    if run is not None:
-        runners.move_to_end(key)
+    runners, run = _cached_runner(model, key)
 
     if run is None:
         def sample(next_logits, rng, done):
@@ -210,11 +233,7 @@ def generate_tokens(model, variables, prompt, num_steps: int,
             return nxt, rng, done
 
         def write_at(buf, nxt, pos):
-            """Write ``nxt`` into buf[:, pos]; ``pos`` scalar or (B,)."""
-            w = jax.nn.one_hot(pos, t, dtype=jnp.int32)
-            if w.ndim == 1:
-                w = w[None, :]
-            return buf * (1 - w) + nxt[:, None] * w
+            return _write_at(buf, nxt, pos, t)
 
         done0 = jnp.zeros((b,), bool)
 
@@ -266,11 +285,152 @@ def generate_tokens(model, variables, prompt, num_steps: int,
                                           jnp.arange(num_steps))
                 return buf
 
-        run = runners[key] = jax.jit(_run)
-        if len(runners) > _RUNNER_CACHE_MAX:
-            runners.popitem(last=False)
+        run = _cache_runner(runners, key, jax.jit(_run))
 
     lens_arg = None if (not ragged or lengths is None) \
         else jnp.asarray(lengths)
     out = run(variables, buf, cache, jax.random.PRNGKey(seed), lens_arg)
     return out[:, :p + num_steps]
+
+
+def generate_beam(model, variables, prompt, num_steps: int,
+                  num_beams: int = 4, eos_id=None,
+                  length_penalty: float = 0.0, use_cache=None,
+                  return_scores: bool = False):
+    """Deterministic beam search: ``num_beams`` hypotheses per row, the
+    highest-(length-normalized)-log-probability continuation returned.
+
+    Beams flatten into the batch dimension (B·K rows), so BOTH decode
+    strategies work unchanged — the KV cache is per-row and beam
+    reindexing is a batch gather inside the scan.  ``eos_id`` freezes a
+    hypothesis at its first EOS (its score stops accumulating);
+    ``length_penalty`` α divides final scores by (generated length)^α.
+    Returns (B, P + num_steps) int32, plus per-row best scores when
+    ``return_scores``.
+    """
+    t = int(model.input_shape[0])
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, p = prompt.shape
+    num_steps = int(num_steps)
+    k_beams = int(num_beams)
+    if k_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    if num_steps < 0:
+        raise ValueError(f"num_steps must be >= 0, got {num_steps}")
+    if not 1 <= p <= t - num_steps:
+        raise ValueError(f"prompt length {p} + {num_steps} steps exceeds "
+                         f"the model's seq_len {t}")
+    if num_steps == 0:
+        out = prompt
+        return (out, jnp.zeros((b,), jnp.float32)) if return_scores else out
+
+    bk = b * k_beams
+    cache = _model_cache(model, bk) if use_cache in (None, True) else None
+    if use_cache is True and cache is None:
+        raise ValueError(
+            "use_cache=True but the cached decode path is unsupported "
+            "here (see generate_tokens); use use_cache=False")
+
+    flat_prompt = jnp.repeat(prompt, k_beams, axis=0)      # (B*K, P)
+    buf = jnp.zeros((bk, t), jnp.int32).at[:, :p].set(flat_prompt)
+    eos = None if eos_id is None else jnp.int32(int(eos_id))
+
+    key = ("beam", p, num_steps, k_beams, cache is not None, b,
+           None if eos_id is None else int(eos_id), float(length_penalty))
+    runners, run = _cached_runner(model, key)
+
+    if run is None:
+        def expand(scores, done, gen_len, logits_prev):
+            """One beam-search selection: (B·K, V) logits → per-row top-K
+            of the K·V continuations → (tokens, source beam rows, ...)."""
+            logp = jax.nn.log_softmax(
+                logits_prev.astype(jnp.float32), axis=-1)
+            v = logp.shape[-1]
+            if eos is not None:
+                # finished beams may only "continue" with EOS at no cost:
+                # the hypothesis is frozen but stays selectable
+                frozen = jnp.full_like(logp, _NEG).at[:, eos].set(0.0)
+                logp = jnp.where(done[:, None], frozen, logp)
+            total = scores[:, None] + logp                  # (B*K, V)
+            total = total.reshape(b, k_beams * v)
+            top, idx = lax.top_k(total, k_beams)            # (B, K)
+            beam = idx // v                                 # source beam
+            tok = (idx % v).astype(jnp.int32)
+            rows = (jnp.arange(b)[:, None] * k_beams + beam).reshape(-1)
+            tok = tok.reshape(-1)
+            new_done = done[rows]
+            new_len = gen_len[rows] + jnp.where(new_done, 0, 1)
+            if eos is not None:
+                new_done = new_done | (tok == eos)
+            return top.reshape(-1), new_done, new_len, tok, rows
+
+        def first_scores():
+            # beam 0 live, beams 1..K-1 at -inf so the FIRST expansion
+            # takes the top-K tokens of the prompt row, not K duplicates
+            s = jnp.full((b, k_beams), _NEG).at[:, 0].set(0.0)
+            return s.reshape(-1)
+
+        def finalize(buf, scores, gen_len):
+            if length_penalty:
+                norm = jnp.maximum(gen_len.astype(jnp.float32), 1.0) \
+                    ** length_penalty
+                scores = scores / norm
+            scores = scores.reshape(b, k_beams)
+            best = jnp.argmax(scores, axis=-1)              # (B,)
+            rows = jnp.arange(b) * k_beams + best
+            return buf[rows], jnp.max(scores, axis=-1)
+
+        done0 = jnp.zeros((bk,), bool)
+        len0 = jnp.zeros((bk,), jnp.int32)
+
+        def write_at(buf, tok, pos):
+            return _write_at(buf, tok, pos, t)
+
+        if cache is not None:
+            def _run(variables, buf, cache):
+                params, state = variables["params"], variables["state"]
+                y, cache = model.layer.apply_prefill(params, state, buf,
+                                                     cache)
+                logits0 = y[:, p - 1]
+
+                def step(carry, i):
+                    buf, cache, scores, done, gen_len, logits_prev = carry
+                    scores, done, gen_len, tok, rows = expand(
+                        scores, done, gen_len, logits_prev)
+                    buf = write_at(buf[rows], tok, p + i)
+                    cache = jax.tree_util.tree_map(lambda c: c[rows],
+                                                   cache)
+                    logits_t, cache = model.layer.apply_decode(
+                        params, state, tok, cache, p + i)
+                    return (buf, cache, scores, done, gen_len,
+                            logits_t), None
+
+                (buf, _, scores, done, gen_len, logits_prev), _ = lax.scan(
+                    step, (buf, cache, first_scores(), done0, len0,
+                           logits0), jnp.arange(num_steps - 1))
+                scores, done, gen_len, tok, rows = expand(
+                    scores, done, gen_len, logits_prev)
+                buf = write_at(buf[rows], tok, p + num_steps - 1)
+                return finalize(buf, scores, gen_len)
+        else:
+            def _run(variables, buf, cache):
+                def step(carry, i):
+                    buf, scores, done, gen_len = carry
+                    logits, _ = model.apply(variables, buf, train=False)
+                    sel = jax.nn.one_hot(p - 1 + i, t, dtype=logits.dtype)
+                    logits_prev = jnp.einsum("btv,t->bv", logits, sel)
+                    scores, done, gen_len, tok, rows = expand(
+                        scores, done, gen_len, logits_prev)
+                    buf = write_at(buf[rows], tok, p + i)
+                    return (buf, scores, done, gen_len), None
+
+                (buf, scores, _, gen_len), _ = lax.scan(
+                    step, (buf, first_scores(), done0, len0),
+                    jnp.arange(num_steps))
+                return finalize(buf, scores, gen_len)
+
+        run = _cache_runner(runners, key, jax.jit(_run))
+
+    out, best_scores = run(variables, buf, cache)
+    out = out[:, :p + num_steps]
+    return (out, best_scores) if return_scores else out
