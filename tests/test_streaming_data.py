@@ -202,3 +202,26 @@ def test_streaming_resume(ds, tmp_path):
                           seed=3, checkpoint_dir=cdir)
     t2.train(src, resume=True)
     assert len(t2.get_history()) == 2
+
+
+def test_async_streaming_exact_resume(ds, tmp_path):
+    """Async + streaming + resume: a resumed worker fast-forwards its
+    stream to the window its commits reached (ps/workers._stream_epochs
+    skip path) and the run completes the remaining windows exactly."""
+    src = _write(ds, tmp_path, rows_per_shard=512)
+    cdir = str(tmp_path / "ck_async_stream")
+    kw = {**COMMON, "num_workers": 2, "communication_window": 4,
+          "seed": 3, "checkpoint_dir": cdir}
+    dk.DOWNPOUR(make_model(), "sgd", mode="async",
+                **{**kw, "num_epoch": 1}).train(src)
+    t2 = dk.DOWNPOUR(make_model(), "sgd", mode="async",
+                     **{**kw, "num_epoch": 3})
+    m = t2.train(src, resume=True)
+    # each worker: 2 shards = 1024 rows / 32 batch = 32 steps -> 8
+    # windows/epoch; 3 epochs = 24 windows total per worker — the resumed
+    # run continued from window 8 (epoch 0's commits) and completed the
+    # remaining 16, never re-committing the first epoch
+    assert t2.ps_stats["commits_by_worker"] == {0: 24, 1: 24}, \
+        t2.ps_stats["commits_by_worker"]
+    pred = dk.ModelPredictor(m, "features").predict(ds)
+    assert dk.AccuracyEvaluator("prediction", "label").evaluate(pred) > 0.8
