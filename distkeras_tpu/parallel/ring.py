@@ -122,6 +122,48 @@ def _ring_attention_flash(q, k, v, axis_name: str, *, causal: bool):
     return o_acc.astype(q.dtype)
 
 
+def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
+                      impl: str = "auto"):
+    """All-to-all sequence parallelism (the DeepSpeed-Ulysses shape);
+    call INSIDE ``shard_map``.
+
+    Instead of rotating K/V around a ring, one ``all_to_all`` re-shards
+    the inputs from sequence-sharded (B, T/P, H, Dh) to HEAD-sharded
+    (B, T, H/P, Dh); each device then runs ordinary FULL-sequence
+    attention over its head group (the fused flash kernel on TPU), and a
+    second ``all_to_all`` restores sequence sharding.  Exact — no online
+    merging — with two collectives total per call vs the ring's P−1
+    ppermute hops; the trade is O(T) activation memory per device during
+    the attention (the ring stays O(T/P)).  Heads must divide the axis
+    size.  Ref (pattern): DeepSpeed-Ulysses (Jacobs et al. 2023) /
+    PAPERS.md; no reference-code equivalent (SURVEY.md §2: strategy
+    ABSENT upstream).
+    """
+    p_size = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % p_size:
+        raise ValueError(f"ulysses needs heads ({h}) divisible by the "
+                         f"{axis_name!r} axis size ({p_size}); use the "
+                         f"ring path for head counts below the mesh")
+    # (B, T/P, H, D) -> (B, T, H/P, D): split heads, concat sequence
+    qh, kh, vh = (lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                 tiled=True) for x in (q, k, v))
+    if impl == "auto":
+        from ..ops.pallas_attention import _HAS_PLTPU
+        impl = "flash" if _HAS_PLTPU else "dense"
+    if impl == "flash":
+        from ..ops.pallas_attention import flash_attention
+        o = flash_attention(qh, kh, vh, causal)
+    elif impl == "dense":
+        from ..ops.attention import dot_product_attention
+        o = dot_product_attention(qh, kh, vh, causal=causal)
+    else:
+        raise ValueError(f"impl must be auto|flash|dense, got {impl!r}")
+    # (B, T, H/P, D) -> (B, T/P, H, D)
+    return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
 def ring_attention_sharded(mesh: Mesh, q, k, v, *, axis: str = "sp",
                            batch_axis: str = None, causal: bool = False,
                            impl: str = "blockwise"):
@@ -132,10 +174,17 @@ def ring_attention_sharded(mesh: Mesh, q, k, v, *, axis: str = "sp",
     mesh axis (dp×sp composition: each dp replica runs its own sequence
     ring over its batch shard — the K/V rotation stays within the sp
     axis, so rings never cross data-parallel replicas).  ``impl``: see
-    :func:`ring_attention` (``"flash"`` = fused Pallas kernel per hop)."""
+    :func:`ring_attention` (``"flash"`` = fused Pallas kernel per hop),
+    plus ``"ulysses"`` for the all-to-all head-sharded formulation
+    (:func:`ulysses_attention` — two collectives instead of a ring)."""
     spec = P(batch_axis, axis)
+    if impl == "ulysses":
+        inner = partial(ulysses_attention, axis_name=axis, causal=causal)
+    else:
+        inner = partial(ring_attention, axis_name=axis, causal=causal,
+                        impl=impl)
     fn = shard_map(
-        partial(ring_attention, axis_name=axis, causal=causal, impl=impl),
+        inner,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
