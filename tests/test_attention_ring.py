@@ -226,3 +226,25 @@ def test_ulysses_matches_ring_and_dense(devices, causal):
     q2 = q[:, :, :4]
     with pytest.raises(ValueError, match="divisible"):
         ring_attention_sharded(mesh, q2, q2, q2, impl="ulysses")
+
+
+def test_mha_ulysses_attachment(devices):
+    """layer.ring_impl='ulysses' routes a mesh-attached MHA through the
+    all-to-all formulation at the model level — same outputs as dense."""
+    import distkeras_tpu as dk
+
+    model = dk.zoo.transformer_classifier(
+        vocab_size=40, dim=64, num_heads=8, num_blocks=1, seq_len=32,
+        num_classes=2)
+    v = model.init(0)
+    rng = np.random.default_rng(3)
+    x = rng.integers(0, 40, size=(4, 32))
+    base, _ = model.apply(v, x)
+    mesh = make_mesh(8, ("sp",))
+    for l in model.iter_layers():
+        if isinstance(l, MultiHeadAttention):
+            l.mesh = mesh
+            l.ring_impl = "ulysses"
+    uly, _ = model.apply(v, x)
+    np.testing.assert_allclose(np.asarray(uly), np.asarray(base),
+                               rtol=2e-4, atol=2e-5)
