@@ -176,7 +176,7 @@ def test_ring_flash_matches_blockwise(devices, causal):
     formulation."""
     mesh = make_mesh(8, ("sp",))
     rng = np.random.default_rng(1)
-    B, T, H, DH = 2, 8 * 16, 2, 8
+    B, T, H, DH = 2, 8 * 8, 2, 8
     q, k, v = (jnp.asarray(rng.normal(size=(B, T, H, DH)), jnp.float32)
                for _ in range(3))
     a = ring_attention_sharded(mesh, q, k, v, causal=causal)

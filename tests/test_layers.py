@@ -186,16 +186,19 @@ def test_resnet50_s2d_stem_trains():
         "label_onehot": np.eye(5, dtype=np.float32)[
             rng.integers(0, 5, 128)]})
     t = dk.SingleTrainer(m, "sgd", "categorical_crossentropy",
-                         label_col="label_onehot", num_epoch=5,
+                         label_col="label_onehot", num_epoch=2,
                          batch_size=32, learning_rate=0.005)
     m = t.train(ds)
     h = t.get_averaged_history()
     assert h[-1] < h[0], h
+    # serde roundtrip: config (incl. the SpaceToDepth stem) + weights
+    # survive; leaf equality avoids a second 50-layer CPU compile
     blob = serde.serialize_model(m, m.variables)
     m2, v2 = serde.deserialize_model(blob)
-    x = jnp.asarray(ds["features"][:4])
-    np.testing.assert_allclose(
-        np.asarray(m.apply(m.variables, x)[0]),
-        np.asarray(m2.apply(v2, x)[0]), rtol=1e-5)
+    import jax
+    for a, b in zip(jax.tree_util.tree_leaves(m.variables),
+                    jax.tree_util.tree_leaves(v2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert type(m2.layer.layers[0]).__name__ == "SpaceToDepth"
     with pytest.raises(ValueError, match="stem"):
         dk.zoo.resnet50(stem="bogus")
