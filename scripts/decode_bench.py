@@ -1,0 +1,74 @@
+"""Autoregressive decode throughput (the BASELINE.md decode tables).
+
+Measures `generate_tokens` / `generate_beam` over the decode surface:
+greedy vs sampled (top-k/top-p), KV-cached vs full-context recompute,
+ragged prompt batches, beam search.  Timing: compile + one warmup call,
+then best-of-3 wall for a full generation (one compiled scan per call —
+per-call dispatch overhead through the axon tunnel is amortized across
+``num_steps`` scan iterations; see scripts/attn_block_bench.py).
+
+Usage: python scripts/decode_bench.py [--dim 256] [--seq 1024] [--batch 8]
+Prints one JSON line per config.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--dim", type=int, default=256)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--blocks", type=int, default=2)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+
+    import numpy as np
+    import jax.numpy as jnp
+    import distkeras_tpu as dk
+
+    model = dk.zoo.gpt_lm(vocab_size=args.vocab, dim=args.dim,
+                          num_heads=args.heads, num_blocks=args.blocks,
+                          seq_len=args.seq)
+    v = model.init(0)
+    rng = np.random.default_rng(0)
+
+    def bench(name, fn, p, steps, batch=None, **kw):
+        b = batch or args.batch
+        prompt = jnp.asarray(rng.integers(0, args.vocab, size=(b, p)),
+                             jnp.int32)
+        np.asarray(fn(model, v, prompt, steps, **kw))  # compile + warmup
+        best = 1e9
+        for s in range(args.reps):
+            t0 = time.perf_counter()
+            np.asarray(fn(model, v, prompt, steps, **kw))
+            best = min(best, time.perf_counter() - t0)
+        toks = b * steps
+        print(json.dumps({
+            "config": name, "prompt": p, "steps": steps, "batch": b,
+            "tok_per_sec": round(toks / best),
+            "ms_per_step": round(best / steps * 1e3, 3)}), flush=True)
+
+    bench("greedy cached", dk.generate_tokens, 16, 512)
+    bench("greedy recompute", dk.generate_tokens, 16, 512,
+          use_cache=False)
+    bench("greedy cached long-prompt", dk.generate_tokens, 512, 256)
+    bench("topk50+topp0.95 T0.8 cached", dk.generate_tokens, 16, 512,
+          temperature=0.8, top_k=50, top_p=0.95, seed=1)
+    lens = rng.integers(64, 513, size=(args.batch,)).astype(np.int32)
+    bench("ragged recompute", dk.generate_tokens, 512, 256,
+          prompt_lengths=lens)
+    bench("beam4 cached", dk.generate_beam, 16, 256, num_beams=4)
+
+
+if __name__ == "__main__":
+    main()
