@@ -223,7 +223,8 @@ def transformer_classifier(vocab_size: int = 20000, dim: int = 128,
 
 def gpt_lm(vocab_size: int = 256, dim: int = 128, num_heads: int = 4,
            num_blocks: int = 2, seq_len: int = 256, ff_mult: int = 4,
-           attention_impl: str = "dense", moe_experts: int = 0) -> Model:
+           attention_impl: str = "dense", moe_experts: int = 0,
+           num_kv_heads=None) -> Model:
     """Decoder-only causal language model (GPT-style) — the canonical
     long-context workload, beyond the reference's LSTM ceiling
     (SURVEY.md §5.7).
@@ -250,7 +251,8 @@ def gpt_lm(vocab_size: int = 256, dim: int = 128, num_heads: int = 4,
         layers.append(Residual(Sequential([
             LayerNorm(),
             MultiHeadAttention(num_heads, causal=True,
-                               impl=attention_impl)])))
+                               impl=attention_impl,
+                               num_kv_heads=num_kv_heads)])))
         layers.append(_ff_block(dim, ff_mult, moe_experts))
     layers += [LayerNorm(), Dense(vocab_size)]
     return Model(Sequential(layers), input_shape=(seq_len,), name="gpt_lm")

@@ -14,6 +14,7 @@ framework ships a standard MXU-friendly attention stack:
 from __future__ import annotations
 
 import math
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -80,7 +81,8 @@ def _flash_with_blocking(q, k, v, causal: bool, t: int):
 @register
 class MultiHeadAttention(Layer):
     """Self-attention over (T, D) inputs; fused qkv projection (one
-    MXU-shaped (D, 3D) GEMM) + output projection.
+    MXU-shaped (D, D + 2·KV·Dh) GEMM — (D, 3D) in the classic
+    full-head case) + output projection.
 
     ``impl``: ``"dense"`` (XLA-fused reference) or ``"flash"`` (the Pallas
     VMEM-resident kernels, ``ops.pallas_attention``: fused forward AND
@@ -98,10 +100,24 @@ class MultiHeadAttention(Layer):
     time_mixing = True  # has its own apply_decode/apply_prefill rules
 
     def __init__(self, num_heads: int, causal: bool = False,
-                 impl: str = "dense"):
+                 impl: str = "dense", num_kv_heads: Optional[int] = None):
         if impl not in ("dense", "flash"):
             raise ValueError(f"impl must be 'dense' or 'flash', got {impl!r}")
         self.num_heads = int(num_heads)
+        #: grouped-query attention (GQA; num_kv_heads=1 ≡ multi-query):
+        #: K/V projections and the DECODE CACHE carry only this many
+        #: heads — cache memory shrinks H/kv× — while query heads share
+        #: each K/V group.  None keeps classic multi-head (and the
+        #: fused-qkv parameter layout, so existing checkpoints load).
+        self.num_kv_heads = None if num_kv_heads is None else int(num_kv_heads)
+        if self.num_kv_heads is not None:
+            if self.num_kv_heads < 1:
+                raise ValueError(f"num_kv_heads must be >= 1, got "
+                                 f"{num_kv_heads}")
+            if self.num_heads % self.num_kv_heads:
+                raise ValueError(
+                    f"num_heads {num_heads} not divisible by num_kv_heads "
+                    f"{num_kv_heads}")
         self.causal = bool(causal)
         self.impl = impl
         self.mesh = None        # runtime attachment → ring attention
@@ -112,27 +128,53 @@ class MultiHeadAttention(Layer):
         #: "blockwise"/"flash" explicitly
         self.ring_impl = None
 
+    @property
+    def _kv(self) -> int:
+        return self.num_kv_heads if self.num_kv_heads is not None \
+            else self.num_heads
+
     def init(self, rng, in_shape):
         t, d = in_shape
         if d % self.num_heads:
             raise ValueError(f"model dim {d} not divisible by "
                              f"{self.num_heads} heads")
         k1, k2 = jax.random.split(rng)
+        dh = d // self.num_heads
         params = {
-            "qkv": glorot_uniform(k1, (d, 3 * d)),
+            # one fused projection for ALL head layouts: (D, D + 2·KV·Dh)
+            # degenerates to the classic (D, 3D) when KV == H, so
+            # pre-GQA checkpoints load unchanged and the single
+            # MXU-shaped GEMM is kept under grouping too
+            "qkv": glorot_uniform(k1, (d, d + 2 * self._kv * dh)),
             "out": glorot_uniform(k2, (d, d)),
         }
         return params, {}, in_shape
 
-    def apply(self, params, state, x, *, train=False, rng=None):
+    def _project(self, params, x):
+        """x (B, T, D) → q (B, T, H, Dh), k/v (B, T, KV, Dh) — one fused
+        GEMM, split at [D, D + KV·Dh]."""
         b, t, d = x.shape
         h = self.num_heads
+        kv = self._kv
         dh = d // h
-        qkv = x @ params["qkv"].astype(x.dtype)          # (B, T, 3D)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, t, h, dh)
-        k = k.reshape(b, t, h, dh)
-        v = v.reshape(b, t, h, dh)
+        qkv = x @ params["qkv"].astype(x.dtype)   # (B, T, D + 2·KV·Dh)
+        q = qkv[..., :d].reshape(b, t, h, dh)
+        k = qkv[..., d:d + kv * dh].reshape(b, t, kv, dh)
+        v = qkv[..., d + kv * dh:].reshape(b, t, kv, dh)
+        return q, k, v
+
+    def _expand_kv(self, k):
+        """(B, T, KV, Dh) → (B, T, H, Dh): query groups share K/V heads
+        (the attention ops and flash kernels take equal head counts;
+        the decode CACHE stays KV-sized — that is where GQA saves)."""
+        g = self.num_heads // self._kv
+        return k if g == 1 else jnp.repeat(k, g, axis=2)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        b, t, d = x.shape
+        q, k, v = self._project(params, x)
+        k = self._expand_kv(k)
+        v = self._expand_kv(v)
         if self.mesh is not None:
             from ..parallel.ring import ring_attention_sharded
             from ..ops.pallas_attention import _HAS_PLTPU
@@ -157,34 +199,38 @@ class MultiHeadAttention(Layer):
     def init_cache(self, batch, in_shape):
         t, d = in_shape
         dh = d // self.num_heads
-        shape = (batch, t, self.num_heads, dh)
+        # KV-head-sized: THE GQA memory win — H/kv× smaller than the
+        # activations' head count
+        shape = (batch, t, self._kv, dh)
         return {"k": jnp.zeros(shape), "v": jnp.zeros(shape)}
 
     def apply_decode(self, params, state, x, cache, pos):
         """One-token cached decode: append this position's K/V to the
         cache, attend the single query over positions <= pos.  O(T·D)
-        per token vs the recompute path's O(T²·D).  Decoding is
-        inherently causal — only meaningful for ``causal=True`` layers."""
+        per token vs the recompute path's O(T²·D).  Grouped-query
+        attention attends via a (KV, G) grouped einsum so the KV-sized
+        cache is never expanded to H heads.  Decoding is inherently
+        causal — only meaningful for ``causal=True`` layers."""
         if not self.causal:
             raise ValueError("cached decode requires causal=True attention")
         b, d = x.shape
         h = self.num_heads
+        kv = self._kv
+        g = h // kv
         dh = d // h
-        qkv = x @ params["qkv"].astype(x.dtype)           # (B, 3D)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = self._project(params, x[:, None, :])
         kc = jax.lax.dynamic_update_slice(
-            cache["k"], k.reshape(b, 1, h, dh).astype(cache["k"].dtype),
-            (0, pos, 0, 0))
+            cache["k"], k.astype(cache["k"].dtype), (0, pos, 0, 0))
         vc = jax.lax.dynamic_update_slice(
-            cache["v"], v.reshape(b, 1, h, dh).astype(cache["v"].dtype),
-            (0, pos, 0, 0))
-        q = q.reshape(b, h, dh)
-        s = jnp.einsum("bhd,bthd->bht", q, kc,
+            cache["v"], v.astype(cache["v"].dtype), (0, pos, 0, 0))
+        # head order matches _expand_kv's repeat: head = kv_idx·G + g
+        qg = q[:, 0].reshape(b, kv, g, dh)
+        s = jnp.einsum("bkgd,btkd->bkgt", qg, kc,
                        preferred_element_type=jnp.float32) / math.sqrt(dh)
         t_idx = jnp.arange(kc.shape[1])
-        s = jnp.where(t_idx[None, None, :] <= pos, s, -1e30)
+        s = jnp.where(t_idx[None, None, None, :] <= pos, s, -1e30)
         w = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bht,bthd->bhd", w,
+        o = jnp.einsum("bkgt,btkd->bkgd", w,
                        vc.astype(jnp.float32)).astype(x.dtype)
         return o.reshape(b, d) @ params["out"].astype(x.dtype), \
             {"k": kc, "v": vc}
@@ -198,24 +244,20 @@ class MultiHeadAttention(Layer):
         if not self.causal:
             raise ValueError("cached decode requires causal=True attention")
         b, t, d = x.shape
-        h = self.num_heads
-        dh = d // h
-        qkv = x @ params["qkv"].astype(x.dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, t, h, dh)
-        k = k.reshape(b, t, h, dh)
-        v = v.reshape(b, t, h, dh)
+        q, k, v = self._project(params, x)
+        cache = {"k": k.astype(cache["k"].dtype),
+                 "v": v.astype(cache["v"].dtype)}
+        k = self._expand_kv(k)
+        v = self._expand_kv(v)
         if self.impl == "flash":
             o = _flash_with_blocking(q, k, v, True, t)
         else:
             o = dot_product_attention(q, k, v, causal=True)
-        cache = {"k": k.astype(cache["k"].dtype),
-                 "v": v.astype(cache["v"].dtype)}
         return o.reshape(b, t, d) @ params["out"].astype(x.dtype), cache
 
     def get_config(self):
         return {"num_heads": self.num_heads, "causal": self.causal,
-                "impl": self.impl}
+                "impl": self.impl, "num_kv_heads": self.num_kv_heads}
 
 
 @register

@@ -575,3 +575,42 @@ def test_generate_beam_finds_higher_probability_than_greedy():
     assert greedy[0, 1] == 1          # greedy falls into the trap
     np.testing.assert_array_equal(beam[0], [0, 2, 3])  # beam escapes
     assert float(score[0]) > np.log(0.599) + np.log(0.25)
+
+
+def test_gqa_grouped_query_attention(lm_ds):
+    """GQA (num_kv_heads < num_heads): trains on the counting task, the
+    decode CACHE carries only kv heads (the memory win), cached decode
+    equals full-context recompute, and serde round-trips the config."""
+    from distkeras_tpu.ops.attention import MultiHeadAttention
+    from distkeras_tpu.utils import serde
+    t = dk.SingleTrainer(small_lm(num_heads=4, num_kv_heads=2), "adam",
+                         "sparse_categorical_crossentropy",
+                         features_col="features", label_col="label",
+                         num_epoch=8, batch_size=64, learning_rate=3e-3)
+    m = t.train(lm_ds)
+    assert token_accuracy(m, lm_ds) > 0.95
+    # cache is kv-head sized: 2 heads, not 4
+    mha = [l for l in m.iter_layers()
+           if isinstance(l, MultiHeadAttention)][0]
+    cache = mha.init_cache(3, (SEQ, 32))
+    assert cache["k"].shape == (3, SEQ, 2, 32 // 4)
+    # both decode strategies agree
+    prompt = jnp.asarray(lm_ds["features"][:2, :8])
+    a = dk.generate_tokens(m, m.variables, prompt, 8)
+    b = dk.generate_tokens(m, m.variables, prompt, 8, use_cache=False)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    expected = (np.asarray(prompt[:, -1:]) + 1 + np.arange(8)[None, :]) \
+        % VOCAB
+    np.testing.assert_array_equal(np.asarray(a[:, 8:]), expected)
+    # serde keeps num_kv_heads and weights
+    m2, v2 = serde.deserialize_model(serde.serialize_model(m, m.variables))
+    x = jnp.asarray(lm_ds["features"][:4])
+    np.testing.assert_allclose(
+        np.asarray(m.apply(m.variables, x)[0]),
+        np.asarray(m2.apply(v2, x)[0]), rtol=1e-5)
+    # kv == h keeps the classic fused-qkv parameter layout (checkpoints)
+    classic = small_lm()
+    v = classic.init(0)
+    assert "qkv" in v["params"][2]["inner"][1]
+    with pytest.raises(ValueError, match="divisible"):
+        MultiHeadAttention(4, num_kv_heads=3)
