@@ -224,7 +224,7 @@ def transformer_classifier(vocab_size: int = 20000, dim: int = 128,
 def gpt_lm(vocab_size: int = 256, dim: int = 128, num_heads: int = 4,
            num_blocks: int = 2, seq_len: int = 256, ff_mult: int = 4,
            attention_impl: str = "dense", moe_experts: int = 0,
-           num_kv_heads=None) -> Model:
+           num_kv_heads=None, positional: str = "learned") -> Model:
     """Decoder-only causal language model (GPT-style) — the canonical
     long-context workload, beyond the reference's LSTM ceiling
     (SURVEY.md §5.7).
@@ -246,13 +246,20 @@ def gpt_lm(vocab_size: int = 256, dim: int = 128, num_heads: int = 4,
     ``transformer_classifier``."""
     from ..ops.attention import (LayerNorm, MultiHeadAttention,
                                  PositionalEmbedding)
-    layers = [Embedding(vocab_size, dim), PositionalEmbedding(seq_len)]
+    if positional not in ("learned", "rope"):
+        raise ValueError(f"positional must be 'learned' or 'rope', got "
+                         f"{positional!r}")
+    rope = positional == "rope"
+    layers = [Embedding(vocab_size, dim)]
+    if not rope:  # rope lives inside the attention layers instead
+        layers.append(PositionalEmbedding(seq_len))
     for _ in range(num_blocks):
         layers.append(Residual(Sequential([
             LayerNorm(),
             MultiHeadAttention(num_heads, causal=True,
                                impl=attention_impl,
-                               num_kv_heads=num_kv_heads)])))
+                               num_kv_heads=num_kv_heads,
+                               rope=rope)])))
         layers.append(_ff_block(dim, ff_mult, moe_experts))
     layers += [LayerNorm(), Dense(vocab_size)]
     return Model(Sequential(layers), input_shape=(seq_len,), name="gpt_lm")

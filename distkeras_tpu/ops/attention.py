@@ -37,6 +37,27 @@ def dot_product_attention(q, k, v, *, causal: bool = False):
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 
+def apply_rope(x, positions, base: float = 10000.0):
+    """Rotary position embedding (RoPE, Su et al. 2021), HALF-SPLIT
+    (GPT-NeoX-style) convention: dim i pairs with dim i + Dh/2 — NOT the
+    interleaved (2i, 2i+1) layout some implementations use; weights are
+    not portable between the two conventions without a permutation.
+    Rotates each pair of ``x`` (…, T, H, Dh) by position-scaled angles.
+    ``positions``: (T,) int — absolute positions of x's time axis (a
+    scalar-position caller passes shape (1,)).  Attention scores between
+    RoPE'd q/k depend only on RELATIVE position, which is what lets a
+    cached decode rotate-then-store."""
+    dh = x.shape[-1]
+    half = dh // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (T, half)
+    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x2 * cos + x1 * sin], axis=-1)
+
+
 _MIN_FLASH_BLOCK = 32  # below this the kernel grid degenerates (perf cliff)
 
 
@@ -100,10 +121,15 @@ class MultiHeadAttention(Layer):
     time_mixing = True  # has its own apply_decode/apply_prefill rules
 
     def __init__(self, num_heads: int, causal: bool = False,
-                 impl: str = "dense", num_kv_heads: Optional[int] = None):
+                 impl: str = "dense", num_kv_heads: Optional[int] = None,
+                 rope: bool = False):
         if impl not in ("dense", "flash"):
             raise ValueError(f"impl must be 'dense' or 'flash', got {impl!r}")
         self.num_heads = int(num_heads)
+        #: rotary position embeddings applied to q/k inside the layer
+        #: (``apply_rope``) — pairs with ``zoo.gpt_lm(positional="rope")``,
+        #: which then drops the learned PositionalEmbedding table
+        self.rope = bool(rope)
         #: grouped-query attention (GQA; num_kv_heads=1 ≡ multi-query):
         #: K/V projections and the DECODE CACHE carry only this many
         #: heads — cache memory shrinks H/kv× — while query heads share
@@ -138,6 +164,10 @@ class MultiHeadAttention(Layer):
         if d % self.num_heads:
             raise ValueError(f"model dim {d} not divisible by "
                              f"{self.num_heads} heads")
+        if self.rope and (d // self.num_heads) % 2:
+            raise ValueError(
+                f"rope=True needs an even head dim, got Dh = "
+                f"{d // self.num_heads} (dim {d} / {self.num_heads} heads)")
         k1, k2 = jax.random.split(rng)
         dh = d // self.num_heads
         params = {
@@ -173,6 +203,16 @@ class MultiHeadAttention(Layer):
     def apply(self, params, state, x, *, train=False, rng=None):
         b, t, d = x.shape
         q, k, v = self._project(params, x)
+        if self.rope:
+            if self.mesh is not None:
+                raise ValueError(
+                    "rope=True with a mesh-attached (sequence-sharded) "
+                    "layer is not supported: per-shard positions need "
+                    "global offsets; detach the mesh or use the learned "
+                    "PositionalEmbedding")
+            pos = jnp.arange(t)
+            q = apply_rope(q, pos)
+            k = apply_rope(k, pos)
         k = self._expand_kv(k)
         v = self._expand_kv(v)
         if self.mesh is not None:
@@ -219,6 +259,12 @@ class MultiHeadAttention(Layer):
         g = h // kv
         dh = d // h
         q, k, v = self._project(params, x[:, None, :])
+        if self.rope:
+            # rotate-then-cache: scores depend on relative position only,
+            # so rotated keys compose with rotated queries at any later pos
+            p1 = jnp.asarray(pos)[None]
+            q = apply_rope(q, p1)
+            k = apply_rope(k, p1)
         kc = jax.lax.dynamic_update_slice(
             cache["k"], k.astype(cache["k"].dtype), (0, pos, 0, 0))
         vc = jax.lax.dynamic_update_slice(
@@ -245,6 +291,10 @@ class MultiHeadAttention(Layer):
             raise ValueError("cached decode requires causal=True attention")
         b, t, d = x.shape
         q, k, v = self._project(params, x)
+        if self.rope:
+            pos = jnp.arange(t)
+            q = apply_rope(q, pos)
+            k = apply_rope(k, pos)
         cache = {"k": k.astype(cache["k"].dtype),
                  "v": v.astype(cache["v"].dtype)}
         k = self._expand_kv(k)
@@ -257,7 +307,8 @@ class MultiHeadAttention(Layer):
 
     def get_config(self):
         return {"num_heads": self.num_heads, "causal": self.causal,
-                "impl": self.impl, "num_kv_heads": self.num_kv_heads}
+                "impl": self.impl, "num_kv_heads": self.num_kv_heads,
+                "rope": self.rope}
 
 
 @register

@@ -614,3 +614,38 @@ def test_gqa_grouped_query_attention(lm_ds):
     assert "qkv" in v["params"][2]["inner"][1]
     with pytest.raises(ValueError, match="divisible"):
         MultiHeadAttention(4, num_kv_heads=3)
+
+
+def test_rope_positional(lm_ds):
+    """RoPE (positional='rope'): no learned position table, trains the
+    counting task, cached decode == full-context recompute (the
+    rotate-then-cache relative-position property), serde round-trips,
+    and mesh attachment is refused with a clear error."""
+    from distkeras_tpu.ops.attention import MultiHeadAttention
+    from distkeras_tpu.parallel.mesh import make_mesh
+    from distkeras_tpu.utils import serde
+    model = small_lm(positional="rope")
+    names = [type(l).__name__ for l in model.layer.layers]
+    assert "PositionalEmbedding" not in names
+    t = dk.SingleTrainer(model, "adam", "sparse_categorical_crossentropy",
+                         features_col="features", label_col="label",
+                         num_epoch=8, batch_size=64, learning_rate=3e-3)
+    m = t.train(lm_ds)
+    assert token_accuracy(m, lm_ds) > 0.95
+    prompt = jnp.asarray(lm_ds["features"][:2, :8])
+    a = dk.generate_tokens(m, m.variables, prompt, 8)
+    b = dk.generate_tokens(m, m.variables, prompt, 8, use_cache=False)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    expected = (np.asarray(prompt[:, -1:]) + 1 + np.arange(8)[None, :]) \
+        % VOCAB
+    np.testing.assert_array_equal(np.asarray(a[:, 8:]), expected)
+    m2, v2 = serde.deserialize_model(serde.serialize_model(m, m.variables))
+    x = jnp.asarray(lm_ds["features"][:4])
+    np.testing.assert_allclose(np.asarray(m.apply(m.variables, x)[0]),
+                               np.asarray(m2.apply(v2, x)[0]), rtol=1e-5)
+    mha = [l for l in m.iter_layers()
+           if isinstance(l, MultiHeadAttention)][0]
+    mha.mesh = make_mesh(8, ("sp",))
+    with pytest.raises(ValueError, match="rope"):
+        m.apply(m.variables, x)
+    mha.mesh = None
