@@ -60,6 +60,17 @@ def _to_class_index(a: np.ndarray, threshold: float = 0.5,
             a = a[..., 0]
         if a.ndim == 2 and a.shape[-1] > 1 and a.min() >= 0 \
                 and a.max() <= 1 and np.all(a.sum(axis=-1) == 1):
+            # (B, T) per-token ids over a binary vocabulary hit this same
+            # shape/value signature; the caller must disambiguate (ADVICE
+            # r4: warn instead of silently argmaxing)
+            import warnings
+            warnings.warn(
+                "auto kind read a 2-D integer array whose rows sum to 1 "
+                "as one-hot rows and argmaxed it; pass prediction_kind/"
+                "label_kind='ids' if the column holds (B, T) per-token "
+                "class ids over a binary vocabulary, or 'onehot' to "
+                "confirm one-hot rows and silence this warning",
+                stacklevel=3)
             return np.argmax(a, axis=-1)  # integer one-hot rows
         return a.astype(np.int64)         # class ids, (B,) or (B, T)
     if a.ndim >= 2 and a.shape[-1] > 1:

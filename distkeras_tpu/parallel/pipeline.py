@@ -50,7 +50,8 @@ def stack_stage_params(stage_params: Sequence[Tree]) -> Tree:
         lambda *leaves: jnp.stack(leaves), *stage_params)
 
 
-def find_stage_segment(layers: Sequence, n_stages: int):
+def find_stage_segment(layers: Sequence, n_stages: int,
+                       input_shape: Sequence[int] | None = None):
     """Locate the homogeneous stage segment of a Sequential layer list.
 
     Returns ``(start, group_len)`` such that
@@ -60,6 +61,13 @@ def find_stage_segment(layers: Sequence, n_stages: int):
     (Residual-attention, FF) blocks.  Picks the longest such span.
     Raises when the stack has none (the model cannot pipeline over
     ``n_stages`` stages).
+
+    ``input_shape`` (the model's per-sample input shape) enables the
+    pp=1 fallback for stacks whose repeated unit occurs only ONCE
+    (e.g. ``gpt_lm(num_blocks=1)``): with a single trivially-runnable
+    stage, any shape-preserving span qualifies, so the longest one is
+    chosen by tracking ``Layer.out_shape`` through the stack
+    (ADVICE r4).
     """
     def sig(lyr):
         return (type(lyr).__name__, repr(lyr.config()))
@@ -70,7 +78,34 @@ def find_stage_segment(layers: Sequence, n_stages: int):
         # the longest-span rule would swallow embedding/head layers whose
         # shapes don't pipeline.  Anchor on the model's actual repeated
         # unit instead: locate it as a 2-stage split, then extend the run.
-        a, g = find_stage_segment(layers, 2)
+        try:
+            a, g = find_stage_segment(layers, 2)
+        except ValueError:
+            # no repeated unit at all (e.g. a single transformer block):
+            # fall back to the longest shape-preserving span — pp=1 runs
+            # it as the one stage with no schedule constraints beyond
+            # shape preservation (state/rng checks stay with the caller)
+            if input_shape is None:
+                raise ValueError(
+                    "pp=1 with no repeated layer group: pass input_shape "
+                    "so the stage segment can be chosen by shape "
+                    "preservation, or raise num_blocks so the repeated "
+                    "unit occurs at least twice")
+            shapes = [tuple(input_shape)]
+            for lyr in layers:
+                shapes.append(tuple(lyr.out_shape(shapes[-1])))
+            best = None
+            for a in range(len(layers)):
+                for end in range(len(layers), a, -1):
+                    if shapes[a] == shapes[end]:
+                        if best is None or end - a > best[1] - best[0]:
+                            best = (a, end)
+                        break
+            if best is None:
+                raise ValueError(
+                    "pp=1 fallback found no shape-preserving span in "
+                    "this stack; the model cannot pipeline")
+            return best[0], best[1] - best[0]
         end = a + 2 * g
         while end + g <= len(layers) and sigs[end:end + g] == sigs[a:a + g]:
             end += g

@@ -79,6 +79,7 @@ def run_cluster_async_training(trainer, dataset,
     # workers must not race the server's bind
     multihost_utils.sync_global_devices("dkps_server_up")
 
+    err = None
     try:
         kw = {}
         if worker_cls is _WORKER_CLASSES["elastic"]:
@@ -92,17 +93,30 @@ def run_cluster_async_training(trainer, dataset,
         worker.set_data(xs[pid], ys[pid])
         worker.run()  # synchronously IN this process (it owns the devices)
         if worker.error is not None:
-            raise worker.error
-        trainer.history = [l for l in worker.losses]
-        # all commits in before process 0 reads the center
-        multihost_utils.sync_global_devices("dkps_workers_done")
-    finally:
-        if server is not None:
-            # barrier above guarantees every worker finished its protocol
-            multihost_utils.sync_global_devices("dkps_stop")
-            server.stop()
+            err = worker.error
         else:
-            multihost_utils.sync_global_devices("dkps_stop")
+            trainer.history = [l for l in worker.losses]
+    except Exception as e:  # noqa: BLE001 — re-raised after the barriers
+        err = e
+    # every process passes this barrier whether its worker succeeded or
+    # not: raising before it would leave healthy processes waiting here
+    # while the failed one ran ahead — mismatched barrier participation
+    # deadlocks the cluster instead of surfacing the error (ADVICE r4)
+    multihost_utils.sync_global_devices("dkps_workers_done")
+    if server is not None:
+        # barrier above guarantees every worker finished its protocol
+        server.stop()
+    # per-process status allgather so EVERY process raises a clear error
+    # when any worker failed, not just the failed one
+    fail_flags = multihost_utils.process_allgather(
+        np.asarray([err is not None]))
+    if err is not None:
+        raise err
+    if fail_flags.any():
+        raise RuntimeError(
+            f"async PS worker failed on process(es) "
+            f"{sorted(np.nonzero(fail_flags.reshape(-1))[0].tolist())}; "
+            f"see their logs for the underlying error")
 
     if pid == 0:
         trainer.ps_stats = {

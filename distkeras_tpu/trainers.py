@@ -1008,7 +1008,8 @@ class PipelineTrainer(Trainer):
             raise ValueError("PipelineTrainer needs a Sequential model "
                              f"(got {type(layer).__name__})")
         S = mesh.shape["pp"]
-        a, g = find_stage_segment(layer.layers, S)
+        a, g = find_stage_segment(layer.layers, S,
+                                  input_shape=self.model.input_shape)
         variables = self.model.init(self.seed)
         params, state = variables["params"], variables["state"]
         span = S * g

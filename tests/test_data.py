@@ -111,3 +111,10 @@ def test_evaluator_kind_disambiguates_binary_tokens():
     assert abs(ev.evaluate(ds) - 4 / 6) < 1e-9
     with pytest.raises(ValueError, match="kind"):
         dk.AccuracyEvaluator(prediction_kind="bogus")
+    # 'auto' still argmaxes the ambiguous shape — but now WARNS, pointing
+    # at the explicit kinds (ADVICE r4: no more silent misread)
+    import warnings
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        dk.AccuracyEvaluator("prediction", "label").evaluate(ds)
+    assert any("prediction_kind" in str(x.message) for x in w)

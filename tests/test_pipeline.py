@@ -189,6 +189,44 @@ def test_find_stage_segment_gpt():
         find_stage_segment(m.layer.layers, 7)
 
 
+def test_find_stage_segment_pp1_single_occurrence():
+    """pp=1 on a stack whose repeated unit occurs only once (ADVICE r4):
+    the shape-preserving-span fallback picks the widest runnable segment
+    instead of rejecting the model."""
+    from distkeras_tpu.parallel.pipeline import find_stage_segment
+    m = _lm_model(num_blocks=1)
+    layers = m.layer.layers
+    # no 2-stage split exists; without a shape hint that is still an error
+    with pytest.raises(ValueError, match="pp=1|homogeneous"):
+        find_stage_segment(layers, 1)
+    a, g = find_stage_segment(layers, 1, input_shape=m.input_shape)
+    shapes = [m.input_shape]
+    for lyr in layers:
+        shapes.append(lyr.out_shape(shapes[-1]))
+    assert shapes[a] == shapes[a + g]  # the span is shape-preserving
+    assert g >= 2  # covers at least the transformer block
+
+
+def test_pipeline_trainer_pp1_single_block():
+    """PipelineTrainer on a pp=1 mesh trains gpt_lm(num_blocks=1) — the
+    degenerate pipeline is trivially runnable and matches SingleTrainer
+    (ADVICE r4: the old segment detection rejected it)."""
+    import distkeras_tpu as dk
+    ds = _lm_fixture(n=64)
+    kw = dict(loss="sparse_categorical_crossentropy",
+              features_col="features", label_col="label", num_epoch=2,
+              batch_size=32, learning_rate=3e-3, seed=5)
+    t_seq = dk.SingleTrainer(_lm_model(num_blocks=1), "adam", **kw)
+    t_seq.train(ds)
+    t_pp = dk.PipelineTrainer(_lm_model(num_blocks=1), "adam",
+                              mesh_shape={"pp": 1}, num_microbatches=2,
+                              **kw)
+    t_pp.train(ds)
+    h_seq = np.concatenate([np.ravel(h) for h in t_seq.get_history()])
+    h_pp = np.concatenate([np.ravel(h) for h in t_pp.get_history()])
+    np.testing.assert_allclose(h_pp, h_seq, rtol=2e-3, atol=2e-3)
+
+
 def test_pipeline_trainer_matches_sequential():
     """The GPipe trainer's loss trajectory matches SingleTrainer on the
     same data/seed — pipelining reorders compute, it does not change the
