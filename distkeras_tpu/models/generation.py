@@ -140,8 +140,11 @@ def generate_tokens(model, variables, prompt, num_steps: int,
     prompt_lengths: (B,) true lengths for RIGHT-padded ragged prompts;
     row b's content is ``prompt[b, :prompt_lengths[b]]`` and its
     continuation lands at positions ``len_b .. len_b+num_steps-1``.
-    Ragged batches run the full-context strategy (the KV cache protocol
-    is uniform-position; recompute reuses the exact training forward).
+    Ragged batches run KV-cached too (r5): one padded prefill, then each
+    row reads/writes its cache at its OWN position (the one-hot decode
+    write takes (B,) positions) — padding K/V recorded by the prefill
+    sits beyond every row's mask horizon and is overwritten as that
+    row's continuation reaches it.
     use_cache: None → auto (KV-cached when the model supports it);
     True forces the cached path (raises if unsupported); False forces
     full-context recompute.
@@ -185,15 +188,9 @@ def generate_tokens(model, variables, prompt, num_steps: int,
                 f"exceeds the model's seq_len {t}")
         ragged = bool((lengths != lengths.max()).any()) or int(
             lengths.max()) != p
-    if ragged and use_cache is True:
-        raise ValueError(
-            "use_cache=True is incompatible with ragged prompt_lengths: "
-            "the KV-cache decode protocol writes at one uniform position "
-            "per step; omit use_cache (full-context recompute handles "
-            "ragged rows exactly)")
 
     cache = None
-    if not ragged and use_cache in (None, True):
+    if use_cache in (None, True):
         cache = _model_cache(model, b)
     if use_cache is True and cache is None:
         raise ValueError(
@@ -238,19 +235,28 @@ def generate_tokens(model, variables, prompt, num_steps: int,
         done0 = jnp.zeros((b,), bool)
 
         if cache is not None:
-            def _run(variables, buf, cache, rng, _lens):
+            def _run(variables, buf, cache, rng, lens):
                 params, state = variables["params"], variables["state"]
                 # batched prefill: one forward fills every layer's cache
                 # (entries past the prompt are masked placeholders,
                 # overwritten as decoding advances)
                 y, cache = model.layer.apply_prefill(params, state, buf,
                                                      cache)
-                logits0 = y[:, p - 1]
+                if lens is None:
+                    logits0 = y[:, p - 1]
+                else:
+                    # per-row read: row b's first continuation follows
+                    # position len_b - 1
+                    sel = jax.nn.one_hot(lens - 1, t, dtype=y.dtype)
+                    logits0 = jnp.einsum("btv,bt->bv", y, sel)
 
                 def step(carry, i):
                     buf, cache, rng, logits_prev, done = carry
                     nxt, rng, done = sample(logits_prev, rng, done)
-                    pos = p - 1 + i
+                    # scalar positions for uniform prompts (cheap
+                    # dynamic-slice cache writes); (B,) per-row positions
+                    # for ragged (one-hot cache writes)
+                    pos = (p - 1 + i) if lens is None else (lens - 1 + i)
                     buf = write_at(buf, nxt, pos + 1)
                     logits_t, cache = model.layer.apply_decode(
                         params, state, nxt, cache, pos + 1)
@@ -262,7 +268,9 @@ def generate_tokens(model, variables, prompt, num_steps: int,
                     step, (buf, cache, rng, logits0, done0),
                     jnp.arange(num_steps - 1))
                 last, _, _ = sample(logits_prev, rng, done)
-                return write_at(buf, last, p - 1 + num_steps)
+                last_pos = (p - 1 + num_steps if lens is None
+                            else lens - 1 + num_steps)
+                return write_at(buf, last, last_pos)
         else:
             def _run(variables, buf, cache, rng, lens):
                 # per-row positions: uniform prompts degenerate to a
@@ -296,7 +304,7 @@ def generate_tokens(model, variables, prompt, num_steps: int,
 def generate_beam(model, variables, prompt, num_steps: int,
                   num_beams: int = 4, eos_id=None,
                   length_penalty: float = 0.0, use_cache=None,
-                  return_scores: bool = False):
+                  return_scores: bool = False, prompt_lengths=None):
     """Deterministic beam search: ``num_beams`` hypotheses per row, the
     highest-(length-normalized)-log-probability continuation returned.
 
@@ -305,8 +313,10 @@ def generate_beam(model, variables, prompt, num_steps: int,
     reindexing is a batch gather inside the scan.  ``eos_id`` freezes a
     hypothesis at its first EOS (its score stops accumulating);
     ``length_penalty`` α divides final scores by (generated length)^α.
-    Returns (B, P + num_steps) int32, plus per-row best scores when
-    ``return_scores``.
+    ``prompt_lengths``: (B,) true lengths for RIGHT-padded ragged
+    prompts (r5) — each row's hypotheses extend from its own length, on
+    either decode strategy.  Returns (B, P + num_steps) int32, plus
+    per-row best scores when ``return_scores``.
     """
     t = int(model.input_shape[0])
     prompt = jnp.asarray(prompt, jnp.int32)
@@ -320,6 +330,21 @@ def generate_beam(model, variables, prompt, num_steps: int,
     if not 1 <= p <= t - num_steps:
         raise ValueError(f"prompt length {p} + {num_steps} steps exceeds "
                          f"the model's seq_len {t}")
+    ragged = False
+    lengths = None
+    if prompt_lengths is not None:
+        lengths = np.asarray(prompt_lengths, np.int32)
+        if lengths.shape != (b,):
+            raise ValueError(f"prompt_lengths shape {lengths.shape} != "
+                             f"({b},)")
+        if lengths.min() < 1 or lengths.max() > p:
+            raise ValueError(f"prompt_lengths must lie in [1, {p}]")
+        if int(lengths.max()) + num_steps > t:
+            raise ValueError(
+                f"longest prompt {int(lengths.max())} + {num_steps} steps "
+                f"exceeds the model's seq_len {t}")
+        ragged = bool((lengths != lengths.max()).any()) or int(
+            lengths.max()) != p
     if num_steps == 0:
         out = prompt
         return (out, jnp.zeros((b,), jnp.float32)) if return_scores else out
@@ -336,7 +361,8 @@ def generate_beam(model, variables, prompt, num_steps: int,
     eos = None if eos_id is None else jnp.int32(int(eos_id))
 
     key = ("beam", p, num_steps, k_beams, cache is not None, b,
-           None if eos_id is None else int(eos_id), float(length_penalty))
+           None if eos_id is None else int(eos_id), float(length_penalty),
+           ragged)
     runners, run = _cached_runner(model, key)
 
     if run is None:
@@ -387,21 +413,29 @@ def generate_beam(model, variables, prompt, num_steps: int,
             return _write_at(buf, tok, pos, t)
 
         if cache is not None:
-            def _run(variables, buf, cache):
+            def _run(variables, buf, cache, lens):
                 params, state = variables["params"], variables["state"]
                 y, cache = model.layer.apply_prefill(params, state, buf,
                                                      cache)
-                logits0 = y[:, p - 1]
+                if lens is None:
+                    logits0 = y[:, p - 1]
+                else:
+                    sel = jax.nn.one_hot(lens - 1, t, dtype=y.dtype)
+                    logits0 = jnp.einsum("btv,bt->bv", y, sel)
 
                 def step(carry, i):
                     buf, cache, scores, done, gen_len, logits_prev = carry
                     scores, done, gen_len, tok, rows = expand(
                         scores, done, gen_len, logits_prev)
-                    buf = write_at(buf[rows], tok, p + i)
+                    # generated position: p+i uniform, len_b+i ragged
+                    # (lens is constant within a row's beam group, so
+                    # beam regathering never changes it)
+                    pos = (p + i) if lens is None else (lens + i)
+                    buf = write_at(buf[rows], tok, pos)
                     cache = jax.tree_util.tree_map(lambda c: c[rows],
                                                    cache)
                     logits_t, cache = model.layer.apply_decode(
-                        params, state, tok, cache, p + i)
+                        params, state, tok, cache, pos)
                     return (buf, cache, scores, done, gen_len,
                             logits_t), None
 
@@ -410,18 +444,27 @@ def generate_beam(model, variables, prompt, num_steps: int,
                            logits0), jnp.arange(num_steps - 1))
                 scores, done, gen_len, tok, rows = expand(
                     scores, done, gen_len, logits_prev)
-                buf = write_at(buf[rows], tok, p + num_steps - 1)
+                last_pos = (p + num_steps - 1 if lens is None
+                            else lens + num_steps - 1)
+                buf = write_at(buf[rows], tok, last_pos)
                 return finalize(buf, scores, gen_len)
         else:
-            def _run(variables, buf, cache):
+            def _run(variables, buf, cache, lens):
                 def step(carry, i):
                     buf, scores, done, gen_len = carry
                     logits, _ = model.apply(variables, buf, train=False)
-                    sel = jax.nn.one_hot(p - 1 + i, t, dtype=logits.dtype)
-                    logits_prev = jnp.einsum("btv,t->bv", logits, sel)
+                    if lens is None:
+                        sel = jax.nn.one_hot(p - 1 + i, t,
+                                             dtype=logits.dtype)
+                        logits_prev = jnp.einsum("btv,t->bv", logits, sel)
+                    else:
+                        sel = jax.nn.one_hot(lens - 1 + i, t,
+                                             dtype=logits.dtype)
+                        logits_prev = jnp.einsum("btv,bt->bv", logits, sel)
                     scores, done, gen_len, tok, rows = expand(
                         scores, done, gen_len, logits_prev)
-                    buf = write_at(buf[rows], tok, p + i)
+                    pos = (p + i) if lens is None else (lens + i)
+                    buf = write_at(buf[rows], tok, pos)
                     return (buf, scores, done, gen_len), None
 
                 (buf, scores, _, gen_len), _ = lax.scan(
@@ -431,6 +474,8 @@ def generate_beam(model, variables, prompt, num_steps: int,
 
         run = _cache_runner(runners, key, jax.jit(_run))
 
-    out, best_scores = run(variables, buf, cache)
+    lens_arg = None if not ragged else jnp.repeat(jnp.asarray(lengths),
+                                                  k_beams, axis=0)
+    out, best_scores = run(variables, buf, cache, lens_arg)
     out = out[:, :p + num_steps]
     return (out, best_scores) if return_scores else out

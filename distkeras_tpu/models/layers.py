@@ -484,21 +484,34 @@ class BatchNorm(Layer):
     def apply(self, params, state, x, *, train=False, rng=None):
         reduce_axes = tuple(range(x.ndim - 1))
         if train:
-            mean = jnp.mean(x, axis=reduce_axes)
-            var = jnp.var(x, axis=reduce_axes)
+            # SIBLING reduces (mean and mean-of-squares over the same
+            # read) fuse into ONE pass over the activations, where
+            # jnp.var's (x − mean)² formulation needs a second,
+            # dependent pass — one full HBM read saved per BN per step
+            # on the conv families (r5 MFU work).  Accumulation is f32
+            # even for bf16 activations.
+            mean = jnp.mean(x, axis=reduce_axes, dtype=jnp.float32)
+            mean2 = jnp.mean(lax.square(x), axis=reduce_axes,
+                             dtype=jnp.float32)
             if self.axis_name is not None:
                 mean = lax.pmean(mean, self.axis_name)
-                var = lax.pmean(var, self.axis_name)
+                mean2 = lax.pmean(mean2, self.axis_name)
+            var = jnp.maximum(mean2 - lax.square(mean), 0.0)
             m = self.momentum
-            new_state = {"mean": m * state["mean"] + (1 - m) * mean.astype(jnp.float32),
-                         "var": m * state["var"] + (1 - m) * var.astype(jnp.float32)}
+            new_state = {"mean": m * state["mean"] + (1 - m) * mean,
+                         "var": m * state["var"] + (1 - m) * var}
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        inv = lax.rsqrt(var.astype(x.dtype) + jnp.asarray(self.epsilon, x.dtype))
-        y = (x - mean.astype(x.dtype)) * inv * params["scale"].astype(x.dtype) \
-            + params["bias"].astype(x.dtype)
-        return y, new_state
+        # per-CHANNEL affine precompute: the (B, H, W, C)-wide loop is
+        # y = x·a + b (one fused multiply-add) instead of the 4-op
+        # subtract/scale/shift chain
+        inv = lax.rsqrt(var.astype(jnp.float32) + self.epsilon)
+        a = (inv * params["scale"].astype(jnp.float32)).astype(x.dtype)
+        b = (params["bias"].astype(jnp.float32)
+             - mean.astype(jnp.float32) * inv
+             * params["scale"].astype(jnp.float32)).astype(x.dtype)
+        return x * a + b, new_state
 
     def get_config(self):
         return {"momentum": self.momentum, "epsilon": self.epsilon,

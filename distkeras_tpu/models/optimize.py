@@ -1,0 +1,106 @@
+"""Inference-graph optimization: BatchNorm folding (r5 MFU work).
+
+Eval-mode BatchNorm is a per-channel affine ``y = x·a + b`` with
+``a = scale/√(var+ε)``, ``b = bias − mean·a`` — EXACTLY absorbable into
+a preceding Conv2D/Dense: ``conv(x; K)·a + b = conv(x; K·a) + b``.
+Folding removes every BN's elementwise pass (and its params/state) from
+the serving graph; the training graph is untouched (training BN uses
+batch statistics, where folding is not exact — reference point:
+standard deployment practice, e.g. TF's fold_batch_norms).
+
+    model2, variables2 = fold_batchnorm(model, variables)
+    y, _ = model2.apply(variables2, x)            # == model.apply eval
+
+Handles Sequential stacks recursively, including the ``Residual``
+combinator's inner/shortcut branches (the ResNet zoo's conv-bn shape).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .layers import BatchNorm, Conv2D, Dense, Residual, Sequential
+from .model import Model
+
+
+def _affine(bn: BatchNorm, bn_params, bn_state):
+    inv = 1.0 / np.sqrt(np.asarray(bn_state["var"], np.float64)
+                        + bn.epsilon)
+    a = np.asarray(bn_params["scale"], np.float64) * inv
+    b = np.asarray(bn_params["bias"], np.float64) \
+        - np.asarray(bn_state["mean"], np.float64) * a
+    return a, b
+
+
+def _fold_into(lyr, p, a, b):
+    """Return (new_layer, new_params) with the BN affine absorbed."""
+    if isinstance(lyr, Conv2D):
+        new = Conv2D(lyr.filters, lyr.kernel_size, lyr.strides,
+                     lyr.padding, lyr.activation, use_bias=True)
+        kernel = np.asarray(p["kernel"], np.float64) * a  # (...,I,O)·(O,)
+        bias = np.asarray(p.get("bias", 0.0), np.float64) * a + b
+    else:  # Dense
+        new = Dense(lyr.units, lyr.activation, use_bias=True)
+        kernel = np.asarray(p["kernel"], np.float64) * a
+        bias = np.asarray(p.get("bias", 0.0), np.float64) * a + b
+    return new, {"kernel": jnp.asarray(kernel, jnp.float32),
+                 "bias": jnp.asarray(bias, jnp.float32)}
+
+
+def _foldable(lyr):
+    # the affine must commute with everything between the kernel op and
+    # the BN: fold only the DIRECTLY adjacent pair, and only when the
+    # kernel op applies no nonlinearity of its own
+    return isinstance(lyr, (Conv2D, Dense)) and lyr.activation is None
+
+
+def _fold_layer(lyr, p, s):
+    """Recursive single-layer fold; returns (layer, params, state)."""
+    if isinstance(lyr, Sequential):
+        return _fold_sequential(lyr.layers, p, s)
+    if isinstance(lyr, Residual):
+        inner, pi, si = _fold_layer(lyr.inner, p["inner"], s["inner"])
+        params = {"inner": pi}
+        state = {"inner": si}
+        shortcut = None
+        if lyr.shortcut is not None:
+            shortcut, ps, ss = _fold_layer(lyr.shortcut, p["shortcut"],
+                                           s["shortcut"])
+            params["shortcut"] = ps
+            state["shortcut"] = ss
+        return Residual(inner, shortcut, lyr.activation), params, state
+    return lyr, p, s
+
+
+def _fold_sequential(layers, params, state):
+    out_l, out_p, out_s = [], [], []
+    i = 0
+    while i < len(layers):
+        lyr, p, s = _fold_layer(layers[i], params[i], state[i])
+        nxt = layers[i + 1] if i + 1 < len(layers) else None
+        if _foldable(lyr) and isinstance(nxt, BatchNorm):
+            a, b = _affine(nxt, params[i + 1], state[i + 1])
+            lyr, p = _fold_into(lyr, p, a, b)
+            s = {}
+            i += 2  # consume the BN
+        else:
+            i += 1
+        out_l.append(lyr)
+        out_p.append(p)
+        out_s.append(s)
+    return Sequential(out_l), out_p, out_s
+
+
+def fold_batchnorm(model: Model, variables: dict):
+    """(model, variables) → (folded_model, folded_variables); exact for
+    EVAL-mode forward passes.  Raises if the top layer is not
+    Sequential."""
+    if not isinstance(model.layer, Sequential):
+        raise ValueError("fold_batchnorm needs a Sequential model, got "
+                         f"{type(model.layer).__name__}")
+    seq, params, state = _fold_sequential(
+        model.layer.layers, variables["params"], variables["state"])
+    folded = Model(seq, input_shape=model.input_shape,
+                   name=model.name + "_bnfold")
+    return folded, {"params": params, "state": state}

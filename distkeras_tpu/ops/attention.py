@@ -44,15 +44,19 @@ def apply_rope(x, positions, base: float = 10000.0):
     not portable between the two conventions without a permutation.
     Rotates each pair of ``x`` (…, T, H, Dh) by position-scaled angles.
     ``positions``: (T,) int — absolute positions of x's time axis (a
-    scalar-position caller passes shape (1,)).  Attention scores between
-    RoPE'd q/k depend only on RELATIVE position, which is what lets a
-    cached decode rotate-then-store."""
+    scalar-position caller passes shape (1,)) — or (B, T) for PER-ROW
+    positions (ragged cached decode: each row sits at its own absolute
+    position).  Attention scores between RoPE'd q/k depend only on
+    RELATIVE position, which is what lets a cached decode
+    rotate-then-store."""
     dh = x.shape[-1]
     half = dh // 2
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (T, half)
-    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
-    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    ang = positions.astype(jnp.float32)[..., None] * freqs  # (…, T, half)
+    if ang.ndim == 2:  # shared positions: broadcast over the batch
+        ang = ang[None]
+    cos = jnp.cos(ang)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[:, :, None, :].astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate([x1 * cos - x2 * sin,
                             x2 * cos + x1 * sin], axis=-1)
@@ -153,6 +157,12 @@ class MultiHeadAttention(Layer):
         #: with the fused kernel per hop, O(T_loc·D) memory); or set
         #: "blockwise"/"flash" explicitly
         self.ring_impl = None
+        #: sequence layout for the causal ring: None → "zigzag" whenever
+        #: causal and T divides 2·|sp| (the load-balanced schedule: every
+        #: device computes the same ≈half-block work per hop instead of
+        #: the contiguous layout's straggler shard); or pin
+        #: "contiguous"/"zigzag" explicitly
+        self.ring_layout = None
 
     @property
     def _kv(self) -> int:
@@ -224,11 +234,19 @@ class MultiHeadAttention(Layer):
             ring_impl = self.ring_impl or (
                 "flash" if self.impl == "flash" and _HAS_PLTPU
                 else "blockwise")
+            layout = self.ring_layout
+            if layout is None and ring_impl != "ulysses":
+                # causal rings default to the load-balanced zigzag
+                # stripe when the length allows (exact; ≈half the FLOPs)
+                sp = self.mesh.shape[self.ring_axis]
+                layout = ("zigzag" if self.causal and t % (2 * sp) == 0
+                          else "contiguous")
             o = ring_attention_sharded(self.mesh, q, k, v,
                                        axis=self.ring_axis,
                                        batch_axis=self.batch_axis,
                                        causal=self.causal,
-                                       impl=ring_impl)
+                                       impl=ring_impl,
+                                       layout=layout or "contiguous")
         elif self.impl == "flash":
             o = _flash_with_blocking(q, k, v, self.causal, t)
         else:
@@ -249,8 +267,11 @@ class MultiHeadAttention(Layer):
         cache, attend the single query over positions <= pos.  O(T·D)
         per token vs the recompute path's O(T²·D).  Grouped-query
         attention attends via a (KV, G) grouped einsum so the KV-sized
-        cache is never expanded to H heads.  Decoding is inherently
-        causal — only meaningful for ``causal=True`` layers."""
+        cache is never expanded to H heads.  ``pos`` may be a scalar
+        (uniform batch) or (B,) — PER-ROW positions for ragged prompts:
+        each row writes its K/V at its own slot (indexed scatter) and
+        masks at its own horizon.  Decoding is inherently causal — only
+        meaningful for ``causal=True`` layers."""
         if not self.causal:
             raise ValueError("cached decode requires causal=True attention")
         b, d = x.shape
@@ -258,23 +279,37 @@ class MultiHeadAttention(Layer):
         kv = self._kv
         g = h // kv
         dh = d // h
+        pos = jnp.asarray(pos)
+        per_row = pos.ndim == 1
         q, k, v = self._project(params, x[:, None, :])
         if self.rope:
             # rotate-then-cache: scores depend on relative position only,
             # so rotated keys compose with rotated queries at any later pos
-            p1 = jnp.asarray(pos)[None]
+            p1 = pos[:, None] if per_row else pos[None]
             q = apply_rope(q, p1)
             k = apply_rope(k, p1)
-        kc = jax.lax.dynamic_update_slice(
-            cache["k"], k.astype(cache["k"].dtype), (0, pos, 0, 0))
-        vc = jax.lax.dynamic_update_slice(
-            cache["v"], v.astype(cache["v"].dtype), (0, pos, 0, 0))
+        if per_row:
+            # indexed scatter (one (KV, Dh) row per batch element) — the
+            # one-hot blend formulation costs a full-buffer
+            # read-modify-write per step (measured +20% on the ragged
+            # decode rate)
+            rows = jnp.arange(b)
+            kc = cache["k"].at[rows, pos].set(
+                k[:, 0].astype(cache["k"].dtype))
+            vc = cache["v"].at[rows, pos].set(
+                v[:, 0].astype(cache["v"].dtype))
+        else:
+            kc = jax.lax.dynamic_update_slice(
+                cache["k"], k.astype(cache["k"].dtype), (0, pos, 0, 0))
+            vc = jax.lax.dynamic_update_slice(
+                cache["v"], v.astype(cache["v"].dtype), (0, pos, 0, 0))
         # head order matches _expand_kv's repeat: head = kv_idx·G + g
         qg = q[:, 0].reshape(b, kv, g, dh)
         s = jnp.einsum("bkgd,btkd->bkgt", qg, kc,
                        preferred_element_type=jnp.float32) / math.sqrt(dh)
         t_idx = jnp.arange(kc.shape[1])
-        s = jnp.where(t_idx[None, None, None, :] <= pos, s, -1e30)
+        horizon = pos[:, None, None, None] if per_row else pos
+        s = jnp.where(t_idx[None, None, None, :] <= horizon, s, -1e30)
         w = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bkgt,btkd->bkgd", w,
                        vc.astype(jnp.float32)).astype(x.dtype)
@@ -353,6 +388,10 @@ class PositionalEmbedding(Layer):
         return x + params["table"][:t].astype(x.dtype), state
 
     def apply_decode(self, params, state, x, cache, pos):
+        pos = jnp.asarray(pos)
+        if pos.ndim == 1:  # per-row positions (ragged cached decode)
+            rows = jnp.take(params["table"], pos, axis=0)  # (B, D)
+            return x + rows.astype(x.dtype), cache
         row = jax.lax.dynamic_slice_in_dim(params["table"], pos, 1, 0)[0]
         return x + row.astype(x.dtype), cache
 

@@ -126,13 +126,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_acc, m_acc, l_acc, *,
 
 
 def _flash_fwd_raw(qr, kr, vr, *, causal, bq, bk, scale):
-    """(BH, T, D) in → (out (BH,T,D), lse (BH,T)) via the fused kernel."""
-    bh, t, dh = qr.shape
+    """(BH, Tq, D) + (BH, Tk, D) in → (out (BH,Tq,D), lse (BH,Tq)) via the
+    fused kernel.  Rectangular Tq ≠ Tk is the ring's half-block hop shape
+    (zigzag schedule); causal requires Tq == Tk (diagonal alignment)."""
+    bh, tq, dh = qr.shape
+    tk = kr.shape[1]
+    if causal and tq != tk:
+        raise ValueError(f"causal flash needs equal q/k lengths, got "
+                         f"{tq} vs {tk}")
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
                                block_q=bq, block_k=bk)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(bh, t // bq, t // bk),
+        grid=(bh, tq // bq, tk // bk),
         in_specs=[
             pl.BlockSpec((1, bq, dh), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, j, 0)),
@@ -145,8 +151,8 @@ def _flash_fwd_raw(qr, kr, vr, *, causal, bq, bk, scale):
             pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, dh), qr.dtype),
-            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq, dh), qr.dtype),
+            jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((bq, dh), jnp.float32),
                         pltpu.VMEM((bq, 128), jnp.float32),
@@ -228,12 +234,13 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dvec_ref,
 
 
 def _flash_bwd_raw(qr, kr, vr, do, lse, dvec, *, causal, bq, bk, scale):
-    bh, t, dh = qr.shape
+    bh, tq, dh = qr.shape
+    tk = kr.shape[1]
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
                           block_q=bq, block_k=bk),
-        grid=(bh, t // bq, t // bk),
+        grid=(bh, tq // bq, tk // bk),
         in_specs=[
             pl.BlockSpec((1, bq, dh), lambda b, i, j: (b, i, 0)),  # q
             pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, j, 0)),  # k
@@ -243,7 +250,7 @@ def _flash_bwd_raw(qr, kr, vr, do, lse, dvec, *, causal, bq, bk, scale):
             pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),   # dvec
         ],
         out_specs=pl.BlockSpec((1, bq, dh), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t, dh), qr.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, dh), qr.dtype),
         scratch_shapes=[pltpu.VMEM((bq, dh), jnp.float32)],
         interpret=_interpret(),
     )(qr, kr, vr, do, lse, dvec)
@@ -251,7 +258,7 @@ def _flash_bwd_raw(qr, kr, vr, do, lse, dvec, *, causal, bq, bk, scale):
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
                           block_q=bq, block_k=bk),
-        grid=(bh, t // bk, t // bq),
+        grid=(bh, tk // bk, tq // bq),
         in_specs=[
             pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, i, 0)),  # k
             pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, i, 0)),  # v
@@ -264,8 +271,8 @@ def _flash_bwd_raw(qr, kr, vr, do, lse, dvec, *, causal, bq, bk, scale):
             pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, dh), lambda b, i, j: (b, i, 0)),
         ],
-        out_shape=[jax.ShapeDtypeStruct((bh, t, dh), kr.dtype),
-                   jax.ShapeDtypeStruct((bh, t, dh), vr.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((bh, tk, dh), kr.dtype),
+                   jax.ShapeDtypeStruct((bh, tk, dh), vr.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, dh), jnp.float32),
                         pltpu.VMEM((bk, dh), jnp.float32)],
         interpret=_interpret(),
@@ -304,15 +311,15 @@ def _auto_block(t: int, dh: int) -> int:
     return 1
 
 
-def _blocks(t, block_q, block_k, dh):
+def _blocks(tq, tk, block_q, block_k, dh):
     if block_q is None:
-        block_q = _auto_block(t, dh)
+        block_q = _auto_block(tq, dh)
     if block_k is None:
-        block_k = _auto_block(t, dh)
-    bq, bk = min(block_q, t), min(block_k, t)
-    if t % bq or t % bk:
-        raise ValueError(f"sequence length {t} must divide block sizes "
-                         f"({bq}, {bk})")
+        block_k = _auto_block(tk, dh)
+    bq, bk = min(block_q, tq), min(block_k, tk)
+    if tq % bq or tk % bk:
+        raise ValueError(f"sequence lengths ({tq}, {tk}) must divide "
+                         f"block sizes ({bq}, {bk})")
     return bq, bk
 
 
@@ -341,7 +348,7 @@ def _vjp_fwd(q, k, v, causal, block_q, block_k):
         raise RuntimeError("pallas TPU module unavailable; use "
                            "dot_product_attention")
     b, t, h, dh = q.shape
-    bq, bk = _blocks(t, block_q, block_k, dh)
+    bq, bk = _blocks(t, k.shape[1], block_q, block_k, dh)
     scale = 1.0 / math.sqrt(dh)
     out, lse = _flash_fwd_raw(_to_bh(q), _to_bh(k), _to_bh(v),
                               causal=causal, bq=bq, bk=bk, scale=scale)
@@ -355,7 +362,7 @@ def _bwd_impl(causal, block_q, block_k, res, g_out, g_lse=None):
     it with the kernels unchanged."""
     q, k, v, out_bh, lse = res
     b, t, h, dh = q.shape
-    bq, bk = _blocks(t, block_q, block_k, dh)
+    bq, bk = _blocks(t, k.shape[1], block_q, block_k, dh)
     scale = 1.0 / math.sqrt(dh)
     do = _to_bh(g_out.astype(q.dtype))
     # D_i = rowsum(dO_i ∘ O_i) — the softmax-grad correction term (f32)

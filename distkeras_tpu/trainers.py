@@ -225,7 +225,17 @@ class Trainer:
         return self._run_cache[1:]
 
     def _finish(self, variables) -> Model:
-        self.trained_variables = jax.tree_util.tree_map(np.asarray, variables)
+        def to_host(x):
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                # multi-HOST mesh (jax.distributed): gather the shards
+                # this process cannot address so every process returns
+                # the same complete trained model (the async cluster's
+                # broadcast contract, for the GSPMD trainers)
+                from jax.experimental import multihost_utils
+                return np.asarray(
+                    multihost_utils.process_allgather(x, tiled=True))
+            return np.asarray(x)
+        self.trained_variables = jax.tree_util.tree_map(to_host, variables)
         self.model.variables = self.trained_variables
         return self.model
 
@@ -795,9 +805,9 @@ class SpmdTrainer(Trainer):
                      "state": spmd.replicate(variables["state"], mesh)}
         self.sharding_report = spmd.sharding_report(variables["params"])
         opt_state = optimizer.init(variables["params"])
-        rng = jax.device_put(jax.random.PRNGKey(self.seed + 1),
-                             jax.sharding.NamedSharding(
-                                 mesh, jax.sharding.PartitionSpec()))
+        rng = spmd.put(jax.random.PRNGKey(self.seed + 1),
+                       jax.sharding.NamedSharding(
+                           mesh, jax.sharding.PartitionSpec()))
         ckpt = self._ckpt_manager()
         opt_shardings = jax.tree_util.tree_map(lambda x: x.sharding,
                                                opt_state)
@@ -808,8 +818,8 @@ class SpmdTrainer(Trainer):
                 "params": spmd.place(variables["params"], mesh, specs),
                 "state": spmd.replicate(variables["state"], mesh)}
             opt_state = jax.tree_util.tree_map(
-                jax.device_put, opt_state, opt_shardings)
-            rng = jax.device_put(rng, jax.sharding.NamedSharding(
+                spmd.put, opt_state, opt_shardings)
+            rng = spmd.put(rng, jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec()))
 
         bsh = spmd.batch_sharding(mesh, dp, batch_dim=1)  # (w, batch, ...)
@@ -825,7 +835,7 @@ class SpmdTrainer(Trainer):
                     wx, wy = next(it)
                     variables, opt_state, rng, l = run(
                         variables, opt_state, rng,
-                        jax.device_put(wx, bsh), jax.device_put(wy, bsh))
+                        spmd.put(wx, bsh), spmd.put(wy, bsh))
                     losses.append(l)
             finally:
                 it.close()
@@ -849,8 +859,8 @@ class SpmdTrainer(Trainer):
         stacked, steps = ds.stacked([self.features_col, self.label_col],
                                     self.batch_size)
         bsh = spmd.batch_sharding(mesh, dp, batch_dim=1)  # (steps, batch,...)
-        xs = jax.device_put(stacked[self.features_col][0], bsh)
-        ys = jax.device_put(stacked[self.label_col][0], bsh)
+        xs = spmd.put(stacked[self.features_col][0], bsh)
+        ys = spmd.put(stacked[self.label_col][0], bsh)
 
         variables = self.model.init(self.seed)
         specs = spmd.infer_param_specs(variables["params"], mesh)
@@ -858,9 +868,9 @@ class SpmdTrainer(Trainer):
                      "state": spmd.replicate(variables["state"], mesh)}
         self.sharding_report = spmd.sharding_report(variables["params"])
         opt_state = optimizer.init(variables["params"])
-        rng = jax.device_put(jax.random.PRNGKey(self.seed + 1),
-                             jax.sharding.NamedSharding(
-                                 mesh, jax.sharding.PartitionSpec()))
+        rng = spmd.put(jax.random.PRNGKey(self.seed + 1),
+                       jax.sharding.NamedSharding(
+                           mesh, jax.sharding.PartitionSpec()))
 
         ckpt = self._ckpt_manager()
         # shardings of the freshly-initialized state, to re-apply on resume
@@ -872,8 +882,8 @@ class SpmdTrainer(Trainer):
                 "params": spmd.place(variables["params"], mesh, specs),
                 "state": spmd.replicate(variables["state"], mesh)}
             opt_state = jax.tree_util.tree_map(
-                jax.device_put, opt_state, opt_shardings)
-            rng = jax.device_put(rng, jax.sharding.NamedSharding(
+                spmd.put, opt_state, opt_shardings)
+            rng = spmd.put(rng, jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec()))
         # AOT-compile the window program (replaces the implicit jit-cache
         # call): one compile per (config, shapes), and the executable stays
@@ -1082,8 +1092,8 @@ class PipelineTrainer(Trainer):
         else:
             bsh = jax.sharding.NamedSharding(mesh,
                                              jax.sharding.PartitionSpec())
-        xs = jax.device_put(stacked_data[self.features_col][0], bsh)
-        ys = jax.device_put(stacked_data[self.label_col][0], bsh)
+        xs = spmd.put(stacked_data[self.features_col][0], bsh)
+        ys = spmd.put(stacked_data[self.label_col][0], bsh)
 
         # placement: stage stacks sharded one-stage-per-device over pp;
         # pre/post replicated
@@ -1092,16 +1102,16 @@ class PipelineTrainer(Trainer):
         rep = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
         place = jax.tree_util.tree_map
         variables = {
-            "params": {"pre": place(lambda x: jax.device_put(x, rep),
+            "params": {"pre": place(lambda x: spmd.put(x, rep),
                                     variables["params"]["pre"]),
-                       "stages": place(lambda x: jax.device_put(x, pp_sh),
+                       "stages": place(lambda x: spmd.put(x, pp_sh),
                                        variables["params"]["stages"]),
-                       "post": place(lambda x: jax.device_put(x, rep),
+                       "post": place(lambda x: spmd.put(x, rep),
                                      variables["params"]["post"])},
             "state": variables["state"],
         }
         opt_state = optimizer.init(variables["params"])
-        rng = jax.device_put(jax.random.PRNGKey(self.seed + 1), rep)
+        rng = spmd.put(jax.random.PRNGKey(self.seed + 1), rep)
 
         ckpt = self._ckpt_manager()
         # shardings of the fresh opt state (stage subtrees inherit the pp
@@ -1113,12 +1123,12 @@ class PipelineTrainer(Trainer):
             ckpt, (variables, opt_state, rng))
         if start_epoch:  # restored host arrays: re-apply placement
             variables = {
-                "params": {"pre": place(lambda x: jax.device_put(x, rep),
+                "params": {"pre": place(lambda x: spmd.put(x, rep),
                                         variables["params"]["pre"]),
                            "stages": place(
-                               lambda x: jax.device_put(x, pp_sh),
+                               lambda x: spmd.put(x, pp_sh),
                                variables["params"]["stages"]),
-                           "post": place(lambda x: jax.device_put(x, rep),
+                           "post": place(lambda x: spmd.put(x, rep),
                                          variables["params"]["post"])},
                 "state": variables["state"],
             }
@@ -1127,10 +1137,10 @@ class PipelineTrainer(Trainer):
             # counts) were single-device uncommitted on the fresh path —
             # commit them replicated so no mixed-device-set conflict
             opt_state = place(
-                lambda x, sh: jax.device_put(
+                lambda x, sh: spmd.put(
                     x, sh if len(sh.device_set) > 1 else rep),
                 opt_state, opt_shardings)
-            rng = jax.device_put(rng, rep)
+            rng = spmd.put(rng, rep)
 
         samples = int(xs.shape[0]) * self.batch_size
         pipe = _EpochPipeline(self, samples)

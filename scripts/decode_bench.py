@@ -65,9 +65,13 @@ def main():
     bench("topk50+topp0.95 T0.8 cached", dk.generate_tokens, 16, 512,
           temperature=0.8, top_k=50, top_p=0.95, seed=1)
     lens = rng.integers(64, 513, size=(args.batch,)).astype(np.int32)
+    bench("ragged cached", dk.generate_tokens, 512, 256,
+          prompt_lengths=lens)   # r5: per-row cache positions
     bench("ragged recompute", dk.generate_tokens, 512, 256,
-          prompt_lengths=lens)
+          prompt_lengths=lens, use_cache=False)
     bench("beam4 cached", dk.generate_beam, 16, 256, num_beams=4)
+    bench("beam4 ragged cached", dk.generate_beam, 512, 128,
+          num_beams=4, prompt_lengths=lens)
 
 
 if __name__ == "__main__":

@@ -202,3 +202,36 @@ def test_resnet50_s2d_stem_trains():
     assert type(m2.layer.layers[0]).__name__ == "SpaceToDepth"
     with pytest.raises(ValueError, match="stem"):
         dk.zoo.resnet50(stem="bogus")
+
+
+def test_fold_batchnorm_exact_on_resnet20():
+    """Inference BN folding (r5): the folded graph drops every BatchNorm
+    (absorbed into adjacent conv kernels) and its EVAL forward equals the
+    original to float tolerance — including through Residual shortcuts."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.models.layers import BatchNorm as BN
+    from distkeras_tpu.models.optimize import fold_batchnorm
+
+    model = dk.zoo.resnet20(width=16)
+    v = model.init(0)
+    # non-trivial running stats (fresh init is mean 0 / var 1: folding
+    # would be trivially right) — perturb them
+    rng = np.random.default_rng(0)
+    v = {"params": v["params"],
+         "state": jax.tree_util.tree_map(
+             lambda x: x + jnp.asarray(
+                 np.abs(rng.normal(0.1, 0.05, x.shape)), x.dtype),
+             v["state"])}
+    x = jnp.asarray(rng.normal(size=(4, 32, 32, 3)), jnp.float32)
+    want, _ = model.apply(v, x, train=False)
+
+    folded, fv = fold_batchnorm(model, v)
+    assert not any(isinstance(l, BN) for l in folded.iter_layers())
+    got, _ = folded.apply(fv, x, train=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+    # parameter count shrinks (scale/bias/mean/var absorbed; conv gains
+    # a bias)
+    n_orig = sum(l.size for l in jax.tree_util.tree_leaves(v))
+    n_fold = sum(np.asarray(l).size for l in jax.tree_util.tree_leaves(fv))
+    assert n_fold < n_orig

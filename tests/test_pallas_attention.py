@@ -110,3 +110,42 @@ def test_flash_bf16_path(causal):
     for a, b in zip(gf, gd):
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b), rtol=0.15, atol=0.15)
+
+
+def test_flash_rectangular_lengths():
+    """Tq != Tk (non-causal): the rectangular hop shape the zigzag ring
+    schedule feeds the kernels — values and grads vs the dense reference
+    (causal still requires equal lengths: clear error)."""
+    from distkeras_tpu.ops.pallas_attention import flash_attention_lse
+    rng = np.random.default_rng(3)
+    B, TQ, TK, H, DH = 2, 16, 48, 2, 8
+    q = jnp.asarray(rng.normal(size=(B, TQ, H, DH)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, TK, H, DH)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, TK, H, DH)), jnp.float32)
+
+    def ref(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(DH)
+        out = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
+        return out, jax.scipy.special.logsumexp(s, axis=-1)
+
+    o, lse = flash_attention_lse(q, k, v, False)
+    o_r, lse_r = ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_r),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_r),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss(fn):
+        def go(q, k, v):
+            o, lse = fn(q, k, v)
+            return jnp.sum(o ** 2) + 0.3 * jnp.sum(jnp.tanh(lse))
+        return go
+
+    g = jax.grad(loss(lambda q, k, v: flash_attention_lse(q, k, v, False)),
+                 argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(loss(ref), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+    with pytest.raises(ValueError, match="equal q/k"):
+        flash_attention_lse(q, k, v, True)

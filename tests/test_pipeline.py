@@ -330,3 +330,35 @@ def test_pipeline_trainer_mixed_precision():
     import jax
     assert all(l.dtype == np.float32
                for l in jax.tree_util.tree_leaves(m.variables["params"]))
+
+
+def test_pipeline_tick_count_is_gpipe_schedule(mesh):
+    """The compiled schedule is exactly GPipe: the scan runs M + S − 1
+    ticks (the (S−1) extra are the fill/drain bubble, quantified in
+    BASELINE.md via scripts/pp_bubble_bench.py)."""
+    from distkeras_tpu.parallel.pipeline import (pipeline_apply_sharded,
+                                                 stack_stage_params)
+    S = 4
+    pp_mesh = make_mesh(S, ("pp",))
+    params = [{"w": jnp.eye(8, dtype=jnp.float32)} for _ in range(S)]
+    stacked = stack_stage_params(params)
+
+    def stage_fn(p, x):
+        return x @ p["w"]
+
+    for M in (4, 8, 16):
+        jaxpr = jax.make_jaxpr(
+            lambda x: pipeline_apply_sharded(pp_mesh, stage_fn, stacked, x,
+                                             num_microbatches=M))(
+            jax.ShapeDtypeStruct((M * 2, 8), jnp.float32))
+
+        def scan_lengths(jx):
+            out = []
+            for eqn in jx.eqns:
+                if eqn.primitive.name == "scan":
+                    out.append(eqn.params["length"])
+            for sub in jax.core.subjaxprs(jx):
+                out.extend(scan_lengths(sub))
+            return out
+
+        assert M + S - 1 in scan_lengths(jaxpr.jaxpr), (M, S)
