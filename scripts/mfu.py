@@ -47,7 +47,11 @@ PEAK_TFLOPS = {
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet20",
-                    choices=["resnet20", "resnet50"])
+                    choices=["resnet20", "resnet50", "lstm"])
+    ap.add_argument("--seq-len", type=int, default=200,
+                    help="lstm: sequence length (the IMDB config's 200)")
+    ap.add_argument("--units", type=int, default=64,
+                    help="lstm: hidden units (the bench config's 64)")
     ap.add_argument("--batch", type=int, default=1024)
     ap.add_argument("--steps", type=int, default=32)
     ap.add_argument("--width", type=int, default=16,
@@ -77,20 +81,43 @@ def main():
     rng = np.random.default_rng(0)
     n = args.steps * args.batch
     s, k = args.image_size, args.classes
+    loss = "categorical_crossentropy"
     if args.model == "resnet20":
         model = zoo.resnet20(num_classes=k, width=args.width)
         label = f"resnet20(width={args.width})"
+    elif args.model == "lstm":
+        if args.width != 16 or args.stem != "conv7" or s != 32 or k != 10:
+            ap.error("--width/--stem/--image-size/--classes apply to the "
+                     "resnet models only (lstm takes --seq-len/--units)")
+        # the AEASGD/EAMSGD bench config's model (the only BASELINE
+        # workload without an MFU row until r5), rebuilt WITHOUT its
+        # Dropout(0.5) so the probe's compiled program is exactly the
+        # embed->LSTM->head math being costed
+        from distkeras_tpu.models.layers import (Dense, Embedding, LSTM,
+                                                 Sequential)
+        from distkeras_tpu.models.model import Model
+        model = Model(Sequential([
+            Embedding(4000, 64),
+            LSTM(args.units),
+            Dense(1, "sigmoid"),
+        ]), input_shape=(args.seq_len,), name="lstm_probe")
+        label = f"lstm_imdb(T={args.seq_len}, units={args.units})"
+        loss = "binary_crossentropy"
     else:
         if args.width != 16:
             ap.error("--width applies to resnet20 only")
         model = zoo.resnet50(num_classes=k, input_size=s, stem=args.stem)
         label = f"resnet50({s}px, stem={args.stem})"
-    xs = rng.random((n, s, s, 3), dtype=np.float32)
-    ys = np.eye(k, dtype=np.float32)[rng.integers(0, k, size=n)]
+    if args.model == "lstm":
+        xs = rng.integers(0, 4000, size=(n, args.seq_len)).astype(np.int32)
+        ys = rng.integers(0, 2, size=(n,)).astype(np.float32)
+    else:
+        xs = rng.random((n, s, s, 3), dtype=np.float32)
+        ys = np.eye(k, dtype=np.float32)[rng.integers(0, k, size=n)]
 
     warmup = 2
     trainer = SingleTrainer(
-        model, "sgd", "categorical_crossentropy",
+        model, "sgd", loss,
         num_epoch=warmup + args.epochs, batch_size=args.batch,
         learning_rate=0.1, compute_dtype=args.dtype)
     run, optimizer = trainer._window_run()
@@ -98,8 +125,8 @@ def main():
     variables = trainer.model.init(0)
     opt_state = optimizer.init(variables["params"])
     key = jax.random.PRNGKey(1)
-    sx = jnp.asarray(xs.reshape(args.steps, args.batch, s, s, 3))
-    sy = jnp.asarray(ys.reshape(args.steps, args.batch, k))
+    sx = jnp.asarray(xs.reshape(args.steps, args.batch, *xs.shape[1:]))
+    sy = jnp.asarray(ys.reshape(args.steps, args.batch, *ys.shape[1:]))
 
     # compiler-counted FLOPs (fwd+bwd+opt).  XLA's HloCostAnalysis counts
     # a while/scan BODY once and does not multiply by trip count (verified
@@ -110,6 +137,16 @@ def main():
     if isinstance(ca, (list, tuple)):  # older jax returns [dict]
         ca = ca[0]
     epoch_flops = float(ca["flops"]) * args.steps
+    if args.model == "lstm":
+        # HloCostAnalysis counts the LSTM's INNER time-axis scan body
+        # once too (same while-body rule as the outer loop), so the
+        # compiler number misses ~T× of the recurrence and its BPTT —
+        # count the recurrence analytically instead: per sample per
+        # time step the fused gate matmul is (E+H)·4H MACs; backward
+        # re-runs it twice (dx and dW products), so ≈ 3× forward.
+        e, h, t_ = 64, args.units, args.seq_len
+        gate_flops = 2 * (e + h) * 4 * h          # fwd MACs → FLOPs
+        epoch_flops = 3.0 * gate_flops * t_ * n
     del variables, opt_state  # donated dummies; the trainer re-inits
 
     # timed through the PUBLIC trainer path — pipelined epochs, per-epoch
