@@ -5,4 +5,4 @@ from .layers import (
 )
 from .model import Model, num_params
 from .generation import generate_beam, generate_tokens
-from .optimize import fold_batchnorm
+from .optimize import fold_batchnorm, zigzag_wrap

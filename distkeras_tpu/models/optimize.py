@@ -20,8 +20,11 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from .layers import BatchNorm, Conv2D, Dense, Residual, Sequential
+from .layers import (BatchNorm, Conv2D, Dense, Embedding, Layer,
+                     Residual, Sequential, register)
 from .model import Model
+
+__all__ = ["fold_batchnorm", "zigzag_wrap", "ZigzagStripe"]
 
 
 def _affine(bn: BatchNorm, bn_params, bn_state):
@@ -90,6 +93,136 @@ def _fold_sequential(layers, params, state):
         out_p.append(p)
         out_s.append(s)
     return Sequential(out_l), out_p, out_s
+
+
+@register
+class ZigzagStripe(Layer):
+    """Re-stripe the token axis into the P-way zigzag ring layout
+    (device d's shard = chunks (d, 2P−1−d)); ``inverse=True`` restores
+    natural order.  Parameter-free and shape-preserving — the once-per-
+    batch boundary layers :func:`zigzag_wrap` inserts."""
+
+    #: permutes the TIME axis: the decode protocol must not apply it
+    #: pointwise to per-token input (generation falls back to the
+    #: full-context recompute path, which runs the whole wrapped forward)
+    time_mixing = True
+
+    def __init__(self, p_size: int, inverse: bool = False):
+        self.p_size = int(p_size)
+        self.inverse = bool(inverse)
+
+    def init(self, rng, in_shape):
+        return {}, {}, tuple(in_shape)
+
+    def out_shape(self, in_shape):
+        return tuple(in_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        from ..parallel.ring import zigzag_shuffle, zigzag_unshuffle
+        f = zigzag_unshuffle if self.inverse else zigzag_shuffle
+        return f(x, self.p_size), state
+
+    def get_config(self):
+        return {"p_size": self.p_size, "inverse": self.inverse}
+
+
+class _ZigzagWrappedModel(Model):
+    """A zigzag-wrapped model is a RUNTIME artifact: its mesh attachment
+    and ``ring_pre_shuffled`` flags are trace-time layer attributes that
+    do not serialize — a config round-trip would restore the stripe
+    boundary layers but run DENSE attention over the permuted order
+    (silently wrong).  Refuse serialization; serialize the ORIGINAL
+    model and re-wrap after loading."""
+
+    def config(self) -> dict:
+        raise ValueError(
+            "cannot serialize a zigzag_wrap'ed model (its mesh "
+            "attachment is runtime-only and a reload would compute "
+            "wrong attention over the striped order); serialize the "
+            "original model and re-apply zigzag_wrap after loading")
+
+
+def zigzag_wrap(model: Model, mesh, *, axis: str = "sp",
+                batch_axis=None, impl=None):
+    """Sequence-parallel CAUSAL training with the zigzag stripe paid
+    ONCE per batch (r5).
+
+    Attaching a mesh to each ``MultiHeadAttention`` runs the balanced
+    zigzag ring, but every attention call then re-stripes its inputs and
+    un-stripes its output — 2 gathers per layer per step.  This wrapper
+    returns a NEW model that stripes the token axis once after the
+    position-dependent embedding layers and un-stripes once at the
+    output head, with every attention layer told its activations are
+    already zigzag (``ring_pre_shuffled``): between the two boundary
+    layers all non-attention compute is token-pointwise, so it runs
+    identically on the striped order.
+
+    Returns ``(wrapped_model, insert_positions)`` — the positions let a
+    caller map variables between the two stacks (the wrapped Sequential
+    has two extra parameter-free layers).  Train the wrapped model from
+    scratch or adapt existing variables by inserting empty ``{}``
+    param/state entries at those positions.
+
+    NOTE: the wrapped model SHARES the original's layer objects (the
+    mesh attachment mutates their runtime placement attributes, like
+    ``layer.mesh = mesh`` does) — don't run the original model while
+    the wrap is active; detach via ``layer.mesh = None;
+    layer.ring_pre_shuffled = False`` to restore it.
+    """
+    from ..ops.attention import MultiHeadAttention, PositionalEmbedding
+    if not isinstance(model.layer, Sequential):
+        raise ValueError("zigzag_wrap needs a Sequential model")
+    p = mesh.shape[axis]
+    t = model.input_shape[0]
+    if t % (2 * p):
+        raise ValueError(f"sequence length {t} must divide 2×|{axis}| "
+                         f"({2 * p}) for the zigzag stripe")
+    layers = list(model.layer.layers)
+    mhas = [l for l in model.iter_layers()
+            if isinstance(l, MultiHeadAttention)]
+    if not mhas:
+        raise ValueError("zigzag_wrap needs attention layers")
+    for l in mhas:
+        if not l.causal:
+            raise ValueError("zigzag_wrap is for CAUSAL attention stacks "
+                             "(non-causal rings don't use the stripe)")
+        if l.rope:
+            raise ValueError("rope positions are applied inside the "
+                             "attention layer from PHYSICAL indices; "
+                             "zigzag_wrap supports learned positional "
+                             "embeddings only")
+    # stripe boundary: after the last position-SENSITIVE pointwise layer
+    # (token/positional embeddings); everything after must be attention
+    # or token-pointwise
+    emb_types = (Embedding, PositionalEmbedding)
+    idx = [i for i, l in enumerate(layers) if isinstance(l, emb_types)]
+    start = (max(idx) + 1) if idx else 0
+    for lyr in layers[start:]:
+        for sub in lyr.iter_layers():
+            if getattr(sub, "time_mixing", False) and \
+                    not isinstance(sub, MultiHeadAttention):
+                raise ValueError(
+                    f"{type(sub).__name__} mixes the time axis and is "
+                    f"not attention: it would read the striped order; "
+                    f"zigzag_wrap cannot wrap this stack")
+    if impl == "ulysses":
+        raise ValueError("impl='ulysses' is the all-to-all formulation — "
+                         "already balanced, no stripe to amortize; "
+                         "zigzag_wrap is for the ring impls")
+    for l in mhas:
+        l.mesh = mesh
+        l.ring_axis = axis
+        if batch_axis is not None:  # preserve an existing dp attachment
+            l.batch_axis = batch_axis
+        if impl is not None:
+            l.ring_impl = impl
+        l.ring_pre_shuffled = True
+    wrapped = layers[:start] + [ZigzagStripe(p)] + layers[start:] \
+        + [ZigzagStripe(p, inverse=True)]
+    m2 = _ZigzagWrappedModel(Sequential(wrapped),
+                             input_shape=model.input_shape,
+                             name=model.name + "_zigzag")
+    return m2, (start, len(wrapped) - 1)
 
 
 def fold_batchnorm(model: Model, variables: dict):

@@ -163,6 +163,10 @@ class MultiHeadAttention(Layer):
         #: the contiguous layout's straggler shard); or pin
         #: "contiguous"/"zigzag" explicitly
         self.ring_layout = None
+        #: set by ``models.optimize.zigzag_wrap``: activations arrive
+        #: ALREADY zigzag-striped (the model re-stripes once per batch),
+        #: so the per-call shuffle/unshuffle is skipped
+        self.ring_pre_shuffled = False
 
     @property
     def _kv(self) -> int:
@@ -235,7 +239,9 @@ class MultiHeadAttention(Layer):
                 "flash" if self.impl == "flash" and _HAS_PLTPU
                 else "blockwise")
             layout = self.ring_layout
-            if layout is None and ring_impl != "ulysses":
+            if self.ring_pre_shuffled:
+                layout = "zigzag"
+            elif layout is None and ring_impl != "ulysses":
                 # causal rings default to the load-balanced zigzag
                 # stripe when the length allows (exact; ≈half the FLOPs)
                 sp = self.mesh.shape[self.ring_axis]
@@ -246,7 +252,8 @@ class MultiHeadAttention(Layer):
                                        batch_axis=self.batch_axis,
                                        causal=self.causal,
                                        impl=ring_impl,
-                                       layout=layout or "contiguous")
+                                       layout=layout or "contiguous",
+                                       pre_shuffled=self.ring_pre_shuffled)
         elif self.impl == "flash":
             o = _flash_with_blocking(q, k, v, self.causal, t)
         else:

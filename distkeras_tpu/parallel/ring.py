@@ -390,7 +390,8 @@ def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
 def ring_attention_sharded(mesh: Mesh, q, k, v, *, axis: str = "sp",
                            batch_axis: str = None, causal: bool = False,
                            impl: str = "blockwise",
-                           layout: str = "contiguous"):
+                           layout: str = "contiguous",
+                           pre_shuffled: bool = False):
     """Whole-array entry point: shards q/k/v on the sequence (T) axis over
     ``mesh[axis]`` and runs ring attention.  q/k/v: (B, T, H, Dh).
 
@@ -408,23 +409,32 @@ def ring_attention_sharded(mesh: Mesh, q, k, v, *, axis: str = "sp",
     ≈half the attention FLOPs of the contiguous causal ring with no
     straggler shard.  The shuffle/unshuffle here is one gather each way;
     a training pipeline that keeps activations zigzag-ordered end-to-end
-    (attention is the only position-sensitive op between shuffles) pays
-    it once per batch, not per layer."""
+    pays it once per batch instead — pass ``pre_shuffled=True`` when
+    q/k/v already arrive in zigzag order (the output stays zigzag; see
+    ``models.optimize.zigzag_wrap``)."""
     spec = P(batch_axis, axis)
     p_size = mesh.shape[axis]
+    if pre_shuffled and layout != "zigzag":
+        raise ValueError("pre_shuffled=True only makes sense with "
+                         "layout='zigzag'")
     if layout == "zigzag":
         if impl == "ulysses":
             raise ValueError("layout='zigzag' is a ring schedule; the "
                              "ulysses all-to-all path is already balanced")
+        if not causal and pre_shuffled:
+            raise ValueError("pre_shuffled zigzag requires causal=True "
+                             "(non-causal rings don't use the stripe)")
         if causal:
-            q = zigzag_shuffle(q, p_size)
-            k = zigzag_shuffle(k, p_size)
-            v = zigzag_shuffle(v, p_size)
+            if not pre_shuffled:
+                q = zigzag_shuffle(q, p_size)
+                k = zigzag_shuffle(k, p_size)
+                v = zigzag_shuffle(v, p_size)
             inner = partial(zigzag_ring_attention, axis_name=axis,
                             impl=impl)
             fn = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
                            out_specs=spec, **_shard_map_kw())
-            return zigzag_unshuffle(fn(q, k, v), p_size)
+            out = fn(q, k, v)
+            return out if pre_shuffled else zigzag_unshuffle(out, p_size)
         # non-causal attention is permutation-invariant over keys and has
         # no masked hops to balance: the plain ring IS the zigzag schedule
         layout = "contiguous"
