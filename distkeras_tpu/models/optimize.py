@@ -175,8 +175,8 @@ def zigzag_wrap(model: Model, mesh, *, axis: str = "sp",
     p = mesh.shape[axis]
     t = model.input_shape[0]
     if t % (2 * p):
-        raise ValueError(f"sequence length {t} must divide 2×|{axis}| "
-                         f"({2 * p}) for the zigzag stripe")
+        raise ValueError(f"sequence length {t} must be divisible by "
+                         f"2×|{axis}| ({2 * p}) for the zigzag stripe")
     layers = list(model.layer.layers)
     mhas = [l for l in model.iter_layers()
             if isinstance(l, MultiHeadAttention)]
@@ -192,11 +192,22 @@ def zigzag_wrap(model: Model, mesh, *, axis: str = "sp",
                              "zigzag_wrap supports learned positional "
                              "embeddings only")
     # stripe boundary: after the last position-SENSITIVE pointwise layer
-    # (token/positional embeddings); everything after must be attention
-    # or token-pointwise
+    # (token/positional embeddings, NESTED occurrences included — a
+    # positional table applied to striped activations would silently
+    # corrupt the model); everything after must be attention or
+    # token-pointwise
     emb_types = (Embedding, PositionalEmbedding)
-    idx = [i for i, l in enumerate(layers) if isinstance(l, emb_types)]
+    idx = [i for i, l in enumerate(layers)
+           if any(isinstance(sub, emb_types) for sub in l.iter_layers())]
     start = (max(idx) + 1) if idx else 0
+    for lyr in layers[:start]:
+        if any(isinstance(sub, MultiHeadAttention)
+               for sub in lyr.iter_layers()):
+            raise ValueError(
+                "attention appears before (or interleaved with) the "
+                "embedding layers: the stripe boundary cannot sit after "
+                "the embeddings without leaving that attention on "
+                "un-striped input; zigzag_wrap cannot wrap this stack")
     for lyr in layers[start:]:
         for sub in lyr.iter_layers():
             if getattr(sub, "time_mixing", False) and \
@@ -205,10 +216,13 @@ def zigzag_wrap(model: Model, mesh, *, axis: str = "sp",
                     f"{type(sub).__name__} mixes the time axis and is "
                     f"not attention: it would read the striped order; "
                     f"zigzag_wrap cannot wrap this stack")
-    if impl == "ulysses":
-        raise ValueError("impl='ulysses' is the all-to-all formulation — "
+    if impl == "ulysses" or (impl is None and
+                             any(l.ring_impl == "ulysses" for l in mhas)):
+        raise ValueError("ulysses is the all-to-all formulation — "
                          "already balanced, no stripe to amortize; "
-                         "zigzag_wrap is for the ring impls")
+                         "zigzag_wrap is for the ring impls (unset "
+                         "layer.ring_impl or pass impl='flash'/"
+                         "'blockwise')")
     for l in mhas:
         l.mesh = mesh
         l.ring_axis = axis

@@ -543,3 +543,109 @@ def test_zigzag_wrap_stripes_once_per_batch(devices):
     t.train(ds)
     h = t.get_averaged_history()
     assert h[-1] < h[0], h
+
+
+def test_zigzag_wrap_composes_with_dp(devices):
+    """zigzag_wrap on a dp×sp mesh: the stripe composes with data
+    parallelism (batch sharded over dp, each dp replica running its own
+    zigzag ring), and a pre-configured batch_axis survives a wrap that
+    doesn't pass one (review r5: it used to be silently reset)."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.models.optimize import zigzag_wrap
+
+    model = dk.zoo.gpt_lm(vocab_size=17, dim=16, num_heads=2,
+                          num_blocks=1, seq_len=16)
+    v = model.init(0)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.integers(0, 17, size=(4, 16)))
+    base, _ = model.apply(v, x)
+
+    mesh2 = make_mesh(shape=(2, 4), axis_names=("dp", "sp"))
+    wrapped, (a, b) = zigzag_wrap(model, mesh2, batch_axis="dp")
+    mhas = [l for l in wrapped.iter_layers()
+            if isinstance(l, MultiHeadAttention)]
+    assert all(l.batch_axis == "dp" for l in mhas)
+    params = list(v["params"])
+    state = list(v["state"])
+    wv = {"params": params[:a] + [{}] + params[a:] + [{}],
+          "state": state[:a] + [{}] + state[a:] + [{}]}
+    got, _ = wrapped.apply(wv, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(base),
+                               rtol=2e-4, atol=2e-5)
+    for l in mhas:  # detach: layer objects are shared with `model`
+        l.mesh = None
+        l.ring_pre_shuffled = False
+
+    # a PRE-configured batch_axis survives a wrap without one
+    model2 = dk.zoo.gpt_lm(vocab_size=17, dim=16, num_heads=2,
+                           num_blocks=1, seq_len=16)
+    for l in model2.iter_layers():
+        if isinstance(l, MultiHeadAttention):
+            l.batch_axis = "dp"
+    w2, _ = zigzag_wrap(model2, mesh2)
+    assert all(l.batch_axis == "dp" for l in w2.iter_layers()
+               if isinstance(l, MultiHeadAttention))
+    # and ulysses is rejected up front, not at first apply
+    with pytest.raises(ValueError, match="ulysses"):
+        zigzag_wrap(model2, mesh2, impl="ulysses")
+
+
+def test_zigzag_wrap_nested_embedding_boundary(devices):
+    """Review r5 repro: a PositionalEmbedding nested one Sequential deep
+    used to land AFTER the stripe (top-level isinstance scan) and
+    silently corrupt outputs by 1e-2.  The boundary scan now covers
+    nested occurrences — the wrap is placed after them and stays exact —
+    and embeddings interleaved WITH attention are refused, as is a
+    pre-set layer.ring_impl='ulysses'."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.models.layers import (Dense, Embedding, Residual,
+                                             Sequential)
+    from distkeras_tpu.models.optimize import zigzag_wrap
+    from distkeras_tpu.ops.attention import (LayerNorm,
+                                             PositionalEmbedding)
+
+    T = 16
+    model = dk.Model(Sequential([
+        Embedding(17, 16),
+        Sequential([PositionalEmbedding(T)]),   # NESTED positional table
+        Residual(Sequential([LayerNorm(),
+                             MultiHeadAttention(2, causal=True)])),
+        LayerNorm(),
+        Dense(17),
+    ]), input_shape=(T,))
+    v = model.init(0)
+    x = jnp.asarray(np.random.default_rng(0).integers(0, 17, size=(2, T)))
+    base, _ = model.apply(v, x)
+    mesh = make_mesh(8, ("sp",))
+    wrapped, (a, b) = zigzag_wrap(model, mesh)
+    assert a == 2  # boundary AFTER the nested positional embedding
+    params = list(v["params"])
+    state = list(v["state"])
+    wv = {"params": params[:a] + [{}] + params[a:] + [{}],
+          "state": state[:a] + [{}] + state[a:] + [{}]}
+    got, _ = wrapped.apply(wv, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(base),
+                               rtol=2e-4, atol=2e-5)
+    for l in wrapped.iter_layers():
+        if isinstance(l, MultiHeadAttention):
+            l.mesh = None
+            l.ring_pre_shuffled = False
+
+    # embedding nested TOGETHER with attention: no valid boundary
+    bad = dk.Model(Sequential([
+        Embedding(17, 16),
+        Residual(Sequential([PositionalEmbedding(T), LayerNorm(),
+                             MultiHeadAttention(2, causal=True)])),
+        Dense(17),
+    ]), input_shape=(T,))
+    with pytest.raises(ValueError, match="interleaved"):
+        zigzag_wrap(bad, mesh)
+
+    # a PRE-SET ulysses ring_impl is rejected up front too
+    m3 = dk.zoo.gpt_lm(vocab_size=17, dim=16, num_heads=2, num_blocks=1,
+                       seq_len=T)
+    for l in m3.iter_layers():
+        if isinstance(l, MultiHeadAttention):
+            l.ring_impl = "ulysses"
+    with pytest.raises(ValueError, match="ulysses"):
+        zigzag_wrap(m3, mesh)

@@ -282,3 +282,63 @@ def test_spmd_trainer_over_two_process_mesh(tmp_path):
     for k, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {k} failed:\n{out}"
         assert f"SPMD_MULTIHOST_OK {k}" in out, out
+
+
+def test_cluster_worker_failure_raises_everywhere_no_deadlock(tmp_path):
+    """ADVICE r4 (medium): a worker failing on ONE process used to skip
+    the 'workers done' barrier and deadlock the whole cluster behind
+    mismatched barrier names.  Now every process passes the same barrier
+    and raises a clear error — both children must EXIT (not hang) with
+    the failure surfaced."""
+    script = tmp_path / "fail_child.py"
+    script.write_text(textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {ROOT!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from distkeras_tpu.parallel import multihost
+        multihost.initialize(coordinator_address=sys.argv[1],
+                             num_processes=2, process_id=int(sys.argv[2]))
+        import distkeras_tpu as dk
+        from distkeras_tpu.ps import workers
+        from distkeras_tpu.ps.cluster import run_cluster_async_training
+        from tests.test_trainers_sync import COMMON, make_model, toy_problem
+
+        if jax.process_index() == 1:
+            # inject a crash into THIS process's worker only
+            def boom(self):
+                self.error = RuntimeError("injected worker crash")
+            workers.PullCommitWorker.run = boom
+
+        ds = toy_problem()
+        t = dk.DOWNPOUR(make_model(), "sgd", num_workers=2,
+                        communication_window=4,
+                        **{{**COMMON, "num_epoch": 2}})
+        try:
+            run_cluster_async_training(t, ds,
+                                       ps_address=("127.0.0.1",
+                                                   int(sys.argv[3])))
+        except RuntimeError as e:
+            print("CLUSTER_FAIL_SURFACED", jax.process_index(),
+                  type(e).__name__, str(e)[:40])
+            raise SystemExit(7)
+        print("CLUSTER_NO_ERROR", jax.process_index())
+    """))
+    addr = f"127.0.0.1:{_free_port()}"
+    ps_port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), addr, str(k), str(ps_port)],
+        env=env, cwd=ROOT, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT) for k in range(2)]
+    outs = []
+    for p in procs:
+        # the old bug HUNG here until the distributed-runtime timeout;
+        # a modest timeout is itself part of the assertion
+        out, _ = p.communicate(timeout=240)
+        outs.append(out.decode())
+    for k, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 7, f"process {k}: rc={p.returncode}\n{out}"
+        assert f"CLUSTER_FAIL_SURFACED {k}" in out, out
