@@ -57,17 +57,22 @@ def sharded_on(mesh: Mesh, axis: str = "workers") -> NamedSharding:
 
 
 def host_to_mesh(mesh: Mesh, tree, axis: str = "workers"):
-    """device_put a host pytree with its leading dim sharded over ``axis``.
+    """Commit a host pytree with its leading dim sharded over ``axis``.
 
     One transfer per leaf: the TPU equivalent of Spark shipping each
-    partition to its executor.
-    """
+    partition to its executor.  On a mesh SPANNING ``jax.distributed``
+    processes each process contributes only the partitions its own
+    devices hold (``spmd.put``) — executor-gets-its-partition for the
+    sync dp trainers too (r5)."""
+    from .spmd import put
     sh = sharded_on(mesh, axis)
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+    return jax.tree_util.tree_map(lambda x: put(x, sh), tree)
 
 
 def broadcast_to_mesh(mesh: Mesh, tree):
-    """device_put a host pytree fully replicated (the 'pull' of the center
-    variable down to every worker, amortized to one transfer)."""
+    """Commit a host pytree fully replicated (the 'pull' of the center
+    variable down to every worker, amortized to one transfer; multi-host
+    aware like :func:`host_to_mesh`)."""
+    from .spmd import put
     sh = replicated(mesh)
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+    return jax.tree_util.tree_map(lambda x: put(x, sh), tree)

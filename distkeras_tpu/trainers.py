@@ -79,7 +79,7 @@ class _EpochPipeline:
         if item is None:
             return
         epoch, dev_losses = item
-        losses = np.asarray(dev_losses)  # waits for that epoch's compute
+        losses = _to_host(dev_losses)  # waits for that epoch's compute
         if self.reshape is not None:
             losses = losses.reshape(self.reshape)
         now = time.time()
@@ -673,8 +673,9 @@ class EnsembleTrainer(DistributedTrainer):
         return inits[0], local
 
     def _collect(self, center, local):
-        # N independent models, all returned (in-RAM and streaming paths)
-        local = jax.tree_util.tree_map(np.asarray, local)
+        # N independent models, all returned (in-RAM and streaming paths;
+        # on a multi-process mesh the worker-sharded stack allgathers)
+        local = jax.tree_util.tree_map(_to_host, local)
         models = []
         for i in range(self.num_workers):
             # type(...) so ingested Keras models (KerasAdapter) work too

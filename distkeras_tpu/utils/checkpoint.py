@@ -24,9 +24,22 @@ from . import serde
 Tree = Any
 
 
+def _leaf_to_host(x):
+    """Device leaf → host numpy.  Leaves sharded over a multi-PROCESS
+    mesh (jax.distributed) cannot be read directly; allgather them so
+    every process checkpoints the complete tree (same bytes everywhere —
+    the atomic rename makes concurrent writers to a shared dir benign,
+    and per-host dirs on a real pod don't collide at all)."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
+
+
 def save_tree(path: str, tree: Tree, meta: Optional[dict] = None) -> None:
-    """Atomically write ``tree``'s leaves (+ JSON-able ``meta``)."""
-    leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+    """Atomically write ``tree``'s leaves (+ JSON-able ``meta``);
+    multi-host aware (see ``_leaf_to_host``)."""
+    leaves = [_leaf_to_host(x) for x in jax.tree_util.tree_leaves(tree)]
     blob = serde.tree_to_bytes({"leaves": leaves, "meta": meta or {}})
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
