@@ -47,9 +47,14 @@ PEAK_TFLOPS = {
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet20",
-                    choices=["resnet20", "resnet50", "lstm"])
-    ap.add_argument("--seq-len", type=int, default=200,
-                    help="lstm: sequence length (the IMDB config's 200)")
+                    choices=["resnet20", "resnet50", "lstm", "gpt"])
+    ap.add_argument("--dim", type=int, default=512,
+                    help="gpt: model width")
+    ap.add_argument("--blocks", type=int, default=4,
+                    help="gpt: transformer blocks")
+    ap.add_argument("--seq-len", type=int, default=None,
+                    help="lstm/gpt sequence length (default: 200 for "
+                         "lstm — the IMDB config — and 512 for gpt)")
     ap.add_argument("--units", type=int, default=64,
                     help="lstm: hidden units (the bench config's 64)")
     ap.add_argument("--batch", type=int, default=1024)
@@ -72,6 +77,10 @@ def main():
     from distkeras_tpu.models import zoo
     from distkeras_tpu.trainers import SingleTrainer
 
+    if args.seq_len is None:
+        args.seq_len = 512 if args.model == "gpt" else 200
+    VOCAB = 4000  # probe vocab: lstm/gpt data + analytic formulas
+
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", "cpu")
     peak = (args.peak_tflops or next(
@@ -85,6 +94,19 @@ def main():
     if args.model == "resnet20":
         model = zoo.resnet20(num_classes=k, width=args.width)
         label = f"resnet20(width={args.width})"
+    elif args.model == "gpt":
+        if args.width != 16 or args.stem != "conv7" or s != 32 or k != 10:
+            ap.error("--width/--stem/--image-size/--classes apply to the "
+                     "resnet models only (gpt takes --dim/--blocks/"
+                     "--seq-len)")
+        # the transformer family's MFU probe: flash attention, bf16 —
+        # completes the ladder across conv / recurrent / attention models
+        model = zoo.gpt_lm(vocab_size=VOCAB, dim=args.dim, num_heads=8,
+                           num_blocks=args.blocks, seq_len=args.seq_len,
+                           attention_impl="flash")
+        label = (f"gpt_lm(T={args.seq_len}, dim={args.dim}, "
+                 f"blocks={args.blocks}, flash)")
+        loss = "sparse_categorical_crossentropy"
     elif args.model == "lstm":
         if args.width != 16 or args.stem != "conv7" or s != 32 or k != 10:
             ap.error("--width/--stem/--image-size/--classes apply to the "
@@ -97,7 +119,7 @@ def main():
                                                  Sequential)
         from distkeras_tpu.models.model import Model
         model = Model(Sequential([
-            Embedding(4000, 64),
+            Embedding(VOCAB, 64),
             LSTM(args.units),
             Dense(1, "sigmoid"),
         ]), input_shape=(args.seq_len,), name="lstm_probe")
@@ -108,8 +130,12 @@ def main():
             ap.error("--width applies to resnet20 only")
         model = zoo.resnet50(num_classes=k, input_size=s, stem=args.stem)
         label = f"resnet50({s}px, stem={args.stem})"
-    if args.model == "lstm":
-        xs = rng.integers(0, 4000, size=(n, args.seq_len)).astype(np.int32)
+    if args.model == "gpt":
+        xs = rng.integers(0, VOCAB, size=(n, args.seq_len)).astype(np.int32)
+        ys = rng.integers(0, VOCAB,
+                          size=(n, args.seq_len)).astype(np.int64)
+    elif args.model == "lstm":
+        xs = rng.integers(0, VOCAB, size=(n, args.seq_len)).astype(np.int32)
         ys = rng.integers(0, 2, size=(n,)).astype(np.float32)
     else:
         xs = rng.random((n, s, s, 3), dtype=np.float32)
@@ -137,7 +163,18 @@ def main():
     if isinstance(ca, (list, tuple)):  # older jax returns [dict]
         ca = ca[0]
     epoch_flops = float(ca["flops"]) * args.steps
-    if args.model == "lstm":
+    if args.model == "gpt":
+        # the flash-attention pallas kernels are custom calls whose FLOPs
+        # HloCostAnalysis cannot see: count the transformer analytically —
+        # per token, 6·(non-embedding params) for the matmul stack
+        # (fwd 2 + bwd 4) plus the attention scores/values product:
+        # 2·2·T·d per token fwd PER BLOCK, ×3 with backward (review r5:
+        # the first formulation dropped the ×L and understated MFU)
+        d, L, t_ = args.dim, args.blocks, args.seq_len
+        matmul_params = L * (4 * d * d + 2 * d * 4 * d) + VOCAB * d
+        per_token = 6 * matmul_params + 3 * L * (4 * t_ * d)
+        epoch_flops = float(per_token) * t_ * n
+    elif args.model == "lstm":
         # HloCostAnalysis counts the LSTM's INNER time-axis scan body
         # once too (same while-body rule as the outer loop), so the
         # compiler number misses ~T× of the recurrence and its BPTT —
