@@ -9,7 +9,7 @@ and a host-side asynchronous parameter server (async-parity path).
 
 __version__ = "0.1.0"
 
-from . import data, models, ops, parallel, utils
+from . import data, models, obs, ops, parallel, utils
 from .data import Dataset
 from .models import Model, Sequential, generate_beam, generate_tokens
 from .trainers import (

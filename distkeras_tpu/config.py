@@ -35,6 +35,8 @@ from typing import Any, Optional
 
 import numpy as np
 
+from .obs import emit
+
 _DEFAULT_TRAINER_KW = dict(loss="categorical_crossentropy",
                            features_col="features",
                            label_col="label_onehot")
@@ -242,24 +244,23 @@ def main(argv=None) -> int:
         cfgs = [c.with_quick() for c in cfgs]
     if args.job:
         if len(cfgs) != 1:
-            print("--job needs a file with exactly one config",
-                  file=sys.stderr)
+            emit("--job needs a file with exactly one config", err=True)
             return 2
         with open(args.job, "wb") as f:
             f.write(to_job(cfgs[0]).package())
-        print(f"wrote job package {args.job}")
+        emit(f"wrote job package {args.job}")
         return 0
 
-    print("| config | samples/sec/chip | spread | accuracy | wall |")
-    print("|---|---|---|---|---|")
+    emit("| config | samples/sec/chip | spread | accuracy | wall |")
+    emit("|---|---|---|---|---|")
     for cfg in cfgs:
         row = run(cfg, repeat=args.repeat)
         acc = f"{row['accuracy']:.3f}" if row["accuracy"] is not None else "—"
         lo, hi = row["spread"]
         spread = "—" if args.repeat <= 1 else f"{lo:,.0f}–{hi:,.0f}"
-        print(f"| {row['name']} | {row['samples_per_sec']:,.0f} "
-              f"({row['note']}) | {spread} | {acc} "
-              f"| {row['wall_seconds']:.1f}s |", flush=True)
+        emit(f"| {row['name']} | {row['samples_per_sec']:,.0f} "
+             f"({row['note']}) | {spread} | {acc} "
+             f"| {row['wall_seconds']:.1f}s |")
     return 0
 
 

@@ -18,6 +18,12 @@ Two pipeline engines, same iterator contract:
 ``SingleTrainer.train`` accepts a ``ShardedFileDataset`` directly: epochs
 stream window-by-window from disk while the TPU trains the previous
 window (the trainer never materializes an epoch in RAM).
+
+Instrumented (ISSUE 2, process-wide default registry): ``stream.batches``
+counts batches handed to consumers, ``stream.stall_seconds`` accumulates
+time a consumer sat blocked on an empty prefetch queue (the disk-bound
+signal: nonzero stall with full occupancy elsewhere means IO can't keep
+up with the device), ``stream.prefetch_occupancy`` gauges queue depth.
 """
 
 from __future__ import annotations
@@ -26,9 +32,12 @@ import json
 import os
 import queue
 import threading
+import time
 from typing import Iterator, Optional, Sequence
 
 import numpy as np
+
+from ..obs import default_registry
 
 _META = "meta.json"
 
@@ -202,7 +211,13 @@ class ShardedFileDataset:
             self._tf_spec_cache[key] = spec
         ds = tf.data.Dataset.from_generator(gen, output_signature=spec)
         ds = ds.prefetch(tf.data.AUTOTUNE)
-        return ((tuple(t.numpy() for t in item)) for item in ds)
+        c_batches = default_registry().counter("stream.batches")
+
+        def consume():
+            for item in ds:
+                c_batches.inc()
+                yield tuple(t.numpy() for t in item)
+        return consume()
 
 
 def window_batches(it: Iterator[tuple], window: int) -> Iterator[tuple]:
@@ -296,13 +311,21 @@ def _prefetched(it: Iterator, depth: int) -> Iterator:
 
     t = threading.Thread(target=produce, daemon=True)
     t.start()
+    reg = default_registry()
+    c_batches = reg.counter("stream.batches")
+    c_stall = reg.counter("stream.stall_seconds")
+    g_occ = reg.gauge("stream.prefetch_occupancy")
     try:
         while True:
-            item = q.get()
+            t0 = time.perf_counter()
+            item = q.get()  # blocks only when the producer is behind
+            c_stall.inc(time.perf_counter() - t0)
+            g_occ.set(q.qsize())
             if item is _END:
                 return
             if isinstance(item, BaseException):
                 raise item
+            c_batches.inc()
             yield item
     finally:
         stop.set()

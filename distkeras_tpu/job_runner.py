@@ -15,6 +15,7 @@ import numpy as np
 from . import trainers as trainers_mod
 from .data import datasets as datasets_mod
 from .data.dataset import Dataset
+from .obs import emit
 from .utils import serde
 
 
@@ -55,8 +56,7 @@ def run_package(pkg_path: str, out_path: str) -> None:
 def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     if len(argv) != 2:
-        print("usage: python -m distkeras_tpu.job_runner PKG OUT",
-              file=sys.stderr)
+        emit("usage: python -m distkeras_tpu.job_runner PKG OUT", err=True)
         return 2
     run_package(argv[0], argv[1])
     return 0

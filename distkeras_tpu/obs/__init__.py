@@ -1,0 +1,27 @@
+"""Telemetry subsystem — counters/gauges/histograms, spans, exposition.
+
+The observability layer the reference out-sourced to Spark's web UI
+(SURVEY/PAPER §5) and this reproduction lacked entirely: process-local
+instruments with mergeable plain-data snapshots (``registry``), nested
+timed scopes sharing the JSONL metrics stream (``spans``), Prometheus text
+rendering (``exposition``) and the library logging/console seam
+(``logging``).  Threaded through the hot layers: the parameter-server
+stack exposes a live ``STATS`` RPC returning a registry snapshot, the
+networking layer counts bytes/round-trips, streaming counts
+batches/stalls, trainers split compile time from steady-state and async
+workers heartbeat — all readable by ``scripts/obsview.py``.
+"""
+
+from .registry import (  # noqa: F401
+    COUNT_BUCKETS,
+    TIME_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    default_registry,
+    snapshot_quantile,
+)
+from .spans import SpanTracer, default_tracer, set_default_sink, span  # noqa: F401
+from .exposition import to_prometheus_text  # noqa: F401
+from .logging import emit, enable_stderr_logging, get_logger  # noqa: F401

@@ -1,0 +1,61 @@
+"""Library logging + the one console seam.
+
+Two distinct audiences, two functions:
+
+* ``get_logger(name)`` — stdlib ``logging`` under the ``distkeras_tpu``
+  namespace for diagnostics.  Library-friendly: a ``NullHandler`` is
+  installed so importing the package never configures global logging;
+  ``enable_stderr_logging()`` opts a script into visible output.
+* ``emit(msg, err=False)`` — deliberate CLI output (usage lines, result
+  tables).  Library code contains **no bare ``print(`` calls** (a tier-1
+  test greps for them); anything user-facing goes through this seam, so
+  output destinations stay swappable and auditable.  Streams are looked
+  up at call time (``sys.stdout``/``sys.stderr``) so capture/redirection
+  works.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Optional
+
+_ROOT = "distkeras_tpu"
+
+logging.getLogger(_ROOT).addHandler(logging.NullHandler())
+
+
+def get_logger(name: Optional[str] = None) -> logging.Logger:
+    """Namespaced library logger (``distkeras_tpu`` or a child)."""
+    if not name:
+        return logging.getLogger(_ROOT)
+    if not name.startswith(_ROOT):
+        name = f"{_ROOT}.{name}"
+    return logging.getLogger(name)
+
+
+def enable_stderr_logging(level: int = logging.INFO) -> logging.Logger:
+    """Attach a stderr handler to the package logger (idempotent) — the
+    opt-in for scripts that want diagnostics on the terminal."""
+    logger = logging.getLogger(_ROOT)
+    if not any(isinstance(h, logging.StreamHandler)
+               and not isinstance(h, logging.NullHandler)
+               for h in logger.handlers):
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s %(message)s"))
+        logger.addHandler(h)
+    logger.setLevel(level)
+    return logger
+
+
+def emit(msg: str = "", *, err: bool = False, flush: bool = True) -> None:
+    """Deliberate console output (CLI tables, usage strings).  The only
+    sanctioned stdout/stderr write in library code."""
+    stream = sys.stderr if err else sys.stdout
+    stream.write(str(msg) + "\n")
+    if flush:
+        try:
+            stream.flush()
+        except OSError:  # pragma: no cover - broken pipe on teardown
+            pass

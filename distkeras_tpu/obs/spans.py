@@ -1,0 +1,102 @@
+"""Nested timed scopes — the trace half of the telemetry layer.
+
+A ``SpanTracer`` keeps a thread-local span stack and emits one record per
+closed span into the SAME JSONL sink the metrics use (``MetricsLogger`` —
+traces and metrics share one stream, so ``scripts/obsview.py`` reads both
+from a single file).  Each record carries the span name, its full
+``parent/child`` path, nesting depth and wall seconds::
+
+    tracer = SpanTracer(metrics_logger)
+    with tracer.span("train"):
+        with tracer.span("jit_compile"):
+            ...   # -> {"event": "span", "name": "jit_compile",
+                  #     "path": "train/jit_compile", "depth": 1,
+                  #     "seconds": 1.83}
+
+Optionally a ``Registry`` accumulates per-name duration histograms
+(``span.<name>.seconds``) so cumulative span time shows up in ``STATS``
+snapshots too.  A process-wide default tracer (``obs.span``) serves ad-hoc
+call sites; components that own a metrics sink build their own.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Optional
+
+from .registry import Registry, TIME_BUCKETS
+
+
+class SpanTracer:
+    """Thread-local nested span stack bound to an optional JSONL sink
+    (anything with ``.log(event, **fields)``) and an optional registry."""
+
+    def __init__(self, sink=None, registry: Optional[Registry] = None):
+        self.sink = sink
+        self.registry = registry
+        self._local = threading.local()
+
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    @property
+    def depth(self) -> int:
+        return len(self._stack())
+
+    def current_path(self) -> str:
+        return "/".join(self._stack())
+
+    @contextlib.contextmanager
+    def span(self, name: str, **fields):
+        """Time a scope; emits on exit (exceptions included — a crashed
+        span still records its duration, flagged ``error=True``)."""
+        stack = self._stack()
+        stack.append(name)
+        path = "/".join(stack)
+        depth = len(stack) - 1
+        t0 = time.perf_counter()
+        try:
+            yield self
+        except BaseException:
+            self._emit(name, path, depth, time.perf_counter() - t0,
+                       dict(fields, error=True))
+            raise
+        else:
+            self._emit(name, path, depth, time.perf_counter() - t0, fields)
+        finally:
+            stack.pop()
+
+    def _emit(self, name: str, path: str, depth: int, seconds: float,
+              fields: dict) -> None:
+        if self.sink is not None:
+            self.sink.log("span", name=name, path=path, depth=depth,
+                          seconds=seconds, **fields)
+        if self.registry is not None:
+            self.registry.histogram(f"span.{name}.seconds",
+                                    TIME_BUCKETS).observe(seconds)
+
+
+_DEFAULT = SpanTracer()
+
+
+def default_tracer() -> SpanTracer:
+    return _DEFAULT
+
+
+def span(name: str, **fields):
+    """Ad-hoc span on the process-wide tracer (silent until a sink is
+    attached via ``set_default_sink``; nesting/paths always tracked)."""
+    return _DEFAULT.span(name, **fields)
+
+
+def set_default_sink(sink, registry: Optional[Registry] = None) -> None:
+    """Point the process-wide tracer at a JSONL sink (and optionally a
+    registry) — e.g. one line in a script turns on ad-hoc tracing."""
+    _DEFAULT.sink = sink
+    if registry is not None:
+        _DEFAULT.registry = registry

@@ -3,24 +3,70 @@
 Parity with the reference's worker-side socket usage (reference
 ``distkeras/workers.py:NetworkWorker.pull``/``commit``): full center down,
 delta up, at communication-window boundaries.
+
+Instrumented (ISSUE 2): every RPC observes its round-trip latency into a
+``ps.client.rtt_seconds`` histogram and reconnect events count under
+``ps.client.reconnects`` (process-wide default registry unless one is
+passed — worker threads share a process, so the default aggregates the
+whole worker pool).  Idempotent reads (``pull``/``stats``) transparently
+reconnect-and-retry once on a broken connection; ``commit`` does NOT
+auto-retry (the server may have applied the delta before the connection
+died — resending would double-apply; the worker-level retry-once policy
+owns that failure, as in the reference's Spark task retry).
 """
 
 from __future__ import annotations
 
+import time
 from typing import Any, Optional
 
+from ..obs import TIME_BUCKETS, Registry, default_registry
 from .networking import connect, recv_msg, send_msg
 
 
 class PSClient:
-    def __init__(self, host: str, port: int, worker_id: int = 0):
+    def __init__(self, host: str, port: int, worker_id: int = 0,
+                 registry: Optional[Registry] = None):
         self.worker_id = int(worker_id)
+        self.host = host
+        self.port = port
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self._h_rtt = self.registry.histogram("ps.client.rtt_seconds",
+                                              TIME_BUCKETS)
+        self._c_reconnects = self.registry.counter("ps.client.reconnects")
         self.sock = connect(host, port)
+
+    def reconnect(self) -> None:
+        """Drop the (possibly broken) connection and dial again."""
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.sock = connect(self.host, self.port)
+        self._c_reconnects.inc()
+
+    def _rpc(self, msg: dict, retry: bool = False) -> Any:
+        """One framed request/response, rtt observed.  ``retry=True``
+        reconnects and resends once on a dead connection — only safe for
+        idempotent reads."""
+        t0 = time.perf_counter()
+        try:
+            send_msg(self.sock, msg, registry=self.registry)
+            resp = recv_msg(self.sock, registry=self.registry)
+        except (ConnectionError, OSError):
+            if not retry:
+                raise
+            self.reconnect()
+            send_msg(self.sock, msg, registry=self.registry)
+            resp = recv_msg(self.sock, registry=self.registry)
+        self._h_rtt.observe(time.perf_counter() - t0)
+        return resp
 
     def pull(self) -> tuple:
         """Returns ``(center_tree, server_update_counter)``."""
-        send_msg(self.sock, {"action": "pull", "worker_id": self.worker_id})
-        resp = recv_msg(self.sock)
+        resp = self._rpc({"action": "pull", "worker_id": self.worker_id},
+                         retry=True)
         return resp["center"], int(resp["updates"])
 
     def commit(self, delta: Any, last_update: Optional[int] = None) -> bool:
@@ -29,14 +75,20 @@ class PSClient:
                "delta": delta}
         if last_update is not None:
             msg["last_update"] = int(last_update)
-        send_msg(self.sock, msg)
-        resp = recv_msg(self.sock)
+        resp = self._rpc(msg)
         return not resp.get("dropped", False)
+
+    def stats(self) -> dict:
+        """Poll the server's live telemetry: ``{"stats": <registry
+        snapshot>, "num_updates": int, "commits_by_worker": dict, ...}`` —
+        no center transfer, safe to call while training runs."""
+        return self._rpc({"action": "stats", "worker_id": self.worker_id},
+                         retry=True)
 
     def close(self) -> None:
         try:
-            send_msg(self.sock, {"action": "stop"})
-            recv_msg(self.sock)
+            send_msg(self.sock, {"action": "stop"}, registry=self.registry)
+            recv_msg(self.sock, registry=self.registry)
         except (ConnectionError, OSError):
             pass
         finally:

@@ -89,7 +89,7 @@ def run_cluster_async_training(trainer, dataset,
             optimizer.init(center["params"]),
             jax.random.PRNGKey(trainer.seed + 1 + pid),
             host if pid != 0 else "127.0.0.1", int(port),
-            trainer.num_epoch, **kw)
+            trainer.num_epoch, metrics=trainer.metrics, **kw)
         worker.set_data(xs[pid], ys[pid])
         worker.run()  # synchronously IN this process (it owns the devices)
         if worker.error is not None:
@@ -122,7 +122,13 @@ def run_cluster_async_training(trainer, dataset,
         trainer.ps_stats = {
             "num_updates": ps.num_updates,
             "commits_by_worker": dict(ps.commits_by_worker),
-            "staleness_seen": list(getattr(ps, "staleness_seen", []))}
+            "staleness_seen": list(getattr(ps, "staleness_seen", [])),
+            "registry": ps.registry.snapshot()}
+        # same stream contract as the single-host runner: the final
+        # registry snapshot lands in process 0's JSONL for obsview
+        trainer.metrics.log("ps_stats", num_updates=ps.num_updates,
+                            commits_by_worker=dict(ps.commits_by_worker),
+                            stats=ps.registry.snapshot())
         final = ps.get_model()
         blob = np.frombuffer(serde.tree_to_bytes(final), np.uint8)
         size = np.asarray([blob.size], np.int64)

@@ -5,6 +5,12 @@ messages), different wire format: the reference pickles arbitrary objects
 (``send_data``/``recv_data``); we frame **msgpack** blobs with a uint64
 length prefix via ``utils.serde`` — safe against arbitrary-code
 deserialization and identical across hosts.
+
+Instrumented (ISSUE 2): every framed send/recv counts messages and wire
+bytes (frame header included) into an ``obs.Registry`` — the component's
+own when the caller passes one (the PS server's ``STATS`` snapshot counts
+its traffic), the process-wide default otherwise; ``connect`` counts
+attempts that failed-and-retried.
 """
 
 from __future__ import annotations
@@ -14,6 +20,7 @@ import struct
 import time
 from typing import Any, Optional
 
+from ..obs import default_registry
 from ..utils import serde
 
 _LEN = struct.Struct(">Q")
@@ -37,21 +44,27 @@ def connect(host: str, port: int, timeout: Optional[float] = 30.0,
     """Connect with retries (the PS thread may not be listening yet —
     the reference relied on Spark task startup latency to hide this)."""
     last = None
+    reg = default_registry()
     for _ in range(max(1, retries)):
         try:
             s = socket.create_connection((host, port), timeout=timeout)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            reg.counter("net.connects").inc()
             return s
         except OSError as e:
             last = e
+            reg.counter("net.connect_retries").inc()
             time.sleep(retry_delay)
     raise ConnectionError(f"cannot connect to {host}:{port}: {last}")
 
 
-def send_msg(sock: socket.socket, obj: Any) -> None:
+def send_msg(sock: socket.socket, obj: Any, registry=None) -> None:
     """Length-prefixed msgpack send (parity: reference ``send_data``)."""
     blob = serde.tree_to_bytes(obj)
     sock.sendall(_LEN.pack(len(blob)) + blob)
+    reg = registry if registry is not None else default_registry()
+    reg.counter("net.msgs_sent").inc()
+    reg.counter("net.bytes_sent").inc(_LEN.size + len(blob))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -65,8 +78,12 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def recv_msg(sock: socket.socket) -> Any:
+def recv_msg(sock: socket.socket, registry=None) -> Any:
     """Recv-all loop for one framed message (parity: reference
     ``recv_data``)."""
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    return serde.tree_from_bytes(_recv_exact(sock, n))
+    msg = serde.tree_from_bytes(_recv_exact(sock, n))
+    reg = registry if registry is not None else default_registry()
+    reg.counter("net.msgs_recv").inc()
+    reg.counter("net.bytes_recv").inc(_LEN.size + n)
+    return msg

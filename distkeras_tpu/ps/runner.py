@@ -21,6 +21,7 @@ import os
 import subprocess
 import sys
 import tempfile
+import time
 
 import jax
 import numpy as np
@@ -106,6 +107,7 @@ def run_async_training(trainer, dataset, fault_injector=None,
                              for k in range(trainer.num_workers)]
             center = ps.get_model()  # workers start from the restored center
     server = SocketParameterServer(ps, fault_injector=fault_injector).start()
+    t_run0 = time.time()  # heartbeats at/after this instant belong to THIS run
 
     try:
         if placement == "processes":
@@ -123,14 +125,39 @@ def run_async_training(trainer, dataset, fault_injector=None,
     # every worker trained that full epoch (the aligned fresh-run case),
     # else the available per-worker arrays (resumed runs may start
     # mid-epoch at per-worker offsets)
+    # only THIS run's heartbeats: the records deque spans the trainer's
+    # lifetime (repeated train() calls reuse epoch indices — timestamps,
+    # not indices, scope a run; eviction by the deque cap just degrades
+    # the affected epochs' dt to 0)
+    heartbeats = [r for r in trainer.metrics.records
+                  if r.get("event") == "heartbeat" and r["ts"] >= t_run0]
     for e in sorted(set().union(*[set(l) for l in losses])):
         rows = [l[e].reshape(-1) for l in losses if e in l]
         trainer.history.append(
             np.stack(rows) if len(rows) == trainer.num_workers else rows)
+        # per-epoch record for the shared stream (sync paths emit these
+        # from _EpochPipeline): loss from the merged rows; wall seconds
+        # bounded by the epoch's heartbeat span (async epochs overlap
+        # across workers — first-to-last commit is the honest window)
+        ts = [r["ts"] for r in heartbeats if r.get("epoch") == e]
+        dt = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+        samples = sum(r.size for r in rows) * trainer.batch_size
+        trainer.metrics.log(
+            "epoch", trainer=type(trainer).__name__, epoch=int(e),
+            mean_loss=float(np.mean(np.concatenate(rows))),
+            epoch_seconds=dt,
+            samples_per_sec=samples / dt if dt > 0 else 0.0)
     trainer.ps_stats = {"num_updates": ps.num_updates,
                         "commits_by_worker": dict(ps.commits_by_worker),
                         "staleness_seen": list(getattr(ps, "staleness_seen",
-                                                       []))}
+                                                       [])),
+                        "registry": ps.registry.snapshot()}
+    # final telemetry record into the run's JSONL stream: the registry
+    # snapshot (staleness/apply-latency histograms, wire bytes, commit/pull
+    # counters) — obsview's staleness-distribution source
+    trainer.metrics.log("ps_stats", num_updates=ps.num_updates,
+                        commits_by_worker=dict(ps.commits_by_worker),
+                        stats=ps.registry.snapshot())
     return trainer._finish(ps.get_model())
 
 
@@ -145,6 +172,9 @@ def _run_thread_workers(trainer, ps, server, mode, center, xs, ys, num_epoch,
                                compute_dtype=trainer.compute_dtype,
                                remat=trainer.remat,
                                aux_weight=trainer.aux_weight)
+    # cold-compile span (first worker to call pays the trace+compile; the
+    # span lands in the shared JSONL stream from that worker's thread)
+    window_fn = trainer._instrumented(window_fn, "async_window")
     worker_cls = _WORKER_CLASSES[mode]
     devices = jax.devices()
     workers = []
@@ -159,7 +189,8 @@ def _run_thread_workers(trainer, ps, server, mode, center, xs, ys, num_epoch,
             jax.random.PRNGKey(trainer.seed + 1 + k), dev)
         w = worker_cls(k, window_fn, variables, opt_state, rng,
                        "127.0.0.1", server.port, num_epoch,
-                       device=dev, start_window=start_windows[k], **kw)
+                       device=dev, start_window=start_windows[k],
+                       metrics=trainer.metrics, **kw)
         if stream is not None:
             w.set_stream(stream.factory(k), stream.n_windows)
         else:
@@ -188,7 +219,8 @@ def _run_thread_workers(trainer, ps, server, mode, center, xs, ys, num_epoch,
             jax.device_put(jax.random.PRNGKey(
                 trainer.seed + 101 + w.worker_id), dev),
             "127.0.0.1", server.port, num_epoch, device=dev,
-            start_window=ps.commits_by_worker.get(w.worker_id, 0), **kw)
+            start_window=ps.commits_by_worker.get(w.worker_id, 0),
+            metrics=trainer.metrics, **kw)
         if stream is not None:
             retry.set_stream(stream.factory(w.worker_id), stream.n_windows)
         else:

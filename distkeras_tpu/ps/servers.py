@@ -11,16 +11,26 @@ subclasses implement the per-commit update rules:
 The center variable is a NumPy pytree (the reference's was a Keras weight
 list).  A ``fault_injector`` hook can drop or delay commits — the test
 harness the reference never had (SURVEY.md §5.3).
+
+Instrumented end to end (ISSUE 2): every server owns an ``obs.Registry``
+(commit/pull counters, apply-latency histogram, per-worker staleness
+histograms, connection/in-flight gauges, wire byte counts), and
+``SocketParameterServer`` answers a ``stats`` action with a full registry
+snapshot plus ground-truth counters — a running PS is pollable live
+(``PSClient.stats()`` / ``scripts/obsview.py --ps host:port``).
 """
 
 from __future__ import annotations
 
+import collections
 import socket
 import threading
+import time
 from typing import Any, Callable, Optional
 
 import numpy as np
 
+from ..obs import COUNT_BUCKETS, TIME_BUCKETS, Registry
 from ..parallel.sync import _inexact, tmap as _tree_map
 from ..utils import native
 from .networking import recv_msg, send_msg
@@ -50,7 +60,8 @@ class ParameterServer:
     reference lacked)."""
 
     def __init__(self, center: Tree, num_workers: int = 1,
-                 checkpoint_manager=None, checkpoint_every: int = 0):
+                 checkpoint_manager=None, checkpoint_every: int = 0,
+                 registry: Optional[Registry] = None):
         self.center = _tree_map(np.asarray, center)
         self.num_workers = int(num_workers)
         self.num_updates = 0
@@ -62,6 +73,14 @@ class ParameterServer:
         self.mutex = threading.Lock()
         self.checkpoint_manager = checkpoint_manager
         self.checkpoint_every = int(checkpoint_every)
+        #: component-scoped instruments: a ``stats`` snapshot describes
+        #: exactly THIS server (a shared/default registry would fold every
+        #: in-process component into the reply)
+        self.registry = registry if registry is not None else Registry()
+        self._c_commits = self.registry.counter("ps.commits")
+        self._c_pulls = self.registry.counter("ps.pulls")
+        self._h_apply = self.registry.histogram("ps.apply_seconds",
+                                                TIME_BUCKETS)
 
     # -- update rule (subclass responsibility) ------------------------------
     def apply_commit(self, delta: Tree, meta: dict) -> None:
@@ -69,6 +88,7 @@ class ParameterServer:
 
     def handle_commit(self, delta: Tree, meta: dict) -> None:
         snapshot = None
+        t0 = time.perf_counter()
         with self.mutex:
             self.apply_commit(delta, meta)
             self.num_updates += 1
@@ -83,6 +103,9 @@ class ParameterServer:
                 # and pulls/commits don't stall on the disk write
                 snapshot = (self.center, self.num_updates,
                             dict(self.commits_by_worker))
+        # lock-held time IS the apply latency workers contend on
+        self._h_apply.observe(time.perf_counter() - t0)
+        self._c_commits.inc()
         if snapshot is not None:
             center, n, by_worker = snapshot
             self.checkpoint_manager.save(
@@ -102,8 +125,21 @@ class ParameterServer:
         return True
 
     def pull(self) -> tuple:
+        self._c_pulls.inc()
         with self.mutex:
             return self.center, self.num_updates
+
+    def stats(self) -> dict:
+        """Registry snapshot + ground-truth counters — the payload the
+        socket front-end returns for a ``stats`` request."""
+        with self.mutex:
+            num_updates = self.num_updates
+            by_worker = dict(self.commits_by_worker)
+        return {"stats": self.registry.snapshot(),
+                "num_updates": num_updates,
+                "commits_by_worker": by_worker,
+                "server": type(self).__name__,
+                "num_workers": self.num_workers}
 
     def get_model(self) -> Tree:
         """Parity: reference ``ParameterServer.get_model``."""
@@ -135,16 +171,40 @@ class DynSGDParameterServer(ParameterServer):
     the worker reports the update counter it last pulled at; staleness =
     current counter − reported; center += delta / (staleness + 1).
 
-    ``staleness_seen`` records the staleness of every commit (observability
-    the reference lacked; surfaced as ``trainer.ps_stats`` after training)."""
+    ``staleness_seen`` keeps the most recent commits' staleness (bounded —
+    the unbounded list leaked on long-lived servers); the full-run
+    distribution lives in the registry's merged ``ps.staleness`` histogram
+    plus per-worker ``ps.staleness.worker<k>`` histograms (surfaced as
+    ``trainer.ps_stats`` after training and via the ``stats`` RPC live)."""
+
+    #: recent-commit window kept verbatim (tail inspection / tests); the
+    #: histograms carry the complete, bounded-memory distribution
+    staleness_keep = 4096
 
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
-        self.staleness_seen: list = []
+        self.staleness_seen: collections.deque = collections.deque(
+            maxlen=self.staleness_keep)
+        self._h_staleness = self.registry.histogram("ps.staleness",
+                                                    COUNT_BUCKETS)
+        #: worker id -> Histogram, cached so the mutex-held apply path
+        #: skips the registry's name-format + lock on every commit
+        self._h_by_worker: dict = {}
+
+    def _worker_hist(self, w: int):
+        h = self._h_by_worker.get(w)
+        if h is None:
+            h = self._h_by_worker[w] = self.registry.histogram(
+                f"ps.staleness.worker{w}", COUNT_BUCKETS)
+        return h
 
     def apply_commit(self, delta, meta):
         staleness = max(0, self.num_updates - int(meta.get("last_update", 0)))
         self.staleness_seen.append(staleness)
+        self._h_staleness.observe(staleness)
+        w = meta.get("worker_id")
+        if w is not None:
+            self._worker_hist(int(w)).observe(staleness)
         self.center = _tree_fused_add(self.center, delta,
                                       1.0 / (staleness + 1))
 
@@ -154,7 +214,10 @@ class SocketParameterServer:
     (parity: reference ``SocketParameterServer.run``/``handle_connection``).
 
     Protocol: each request is one framed msgpack map with an ``action`` key
-    (``pull`` / ``commit`` / ``stop``); every request gets a response.
+    (``pull`` / ``commit`` / ``stats`` / ``stop``); every request gets a
+    response.  ``stats`` returns the PS registry snapshot + ground-truth
+    counters without touching the center — the live-poll path
+    (``PSClient.stats()``, ``scripts/obsview.py --ps``).
     """
 
     def __init__(self, ps: ParameterServer, host: str = "127.0.0.1",
@@ -169,6 +232,11 @@ class SocketParameterServer:
         self._conns: list = []
         self._conn_lock = threading.Lock()
         self._running = threading.Event()
+        #: front-end instruments live in the PS's registry so one snapshot
+        #: covers update rules AND wire traffic
+        self._g_conns = ps.registry.gauge("ps.connections")
+        self._g_inflight = ps.registry.gauge("ps.inflight")
+        self._c_dropped = ps.registry.counter("ps.commits_dropped")
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "SocketParameterServer":
@@ -218,35 +286,48 @@ class SocketParameterServer:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._conn_lock:
                 self._conns.append(conn)
+            self._g_conns.inc()
             t = threading.Thread(target=self._handle_connection, args=(conn,),
                                  daemon=True, name="ps-conn")
             t.start()
             self._threads.append(t)
 
     def _handle_connection(self, conn: socket.socket):
+        reg = self.ps.registry
         try:
             while self._running.is_set():
                 try:
-                    msg = recv_msg(conn)
+                    msg = recv_msg(conn, registry=reg)
                 except (ConnectionError, OSError):
                     return
                 action = msg.get("action")
-                if action == "pull":
-                    center, updates = self.ps.pull()
-                    send_msg(conn, {"center": center, "updates": updates})
-                elif action == "commit":
-                    dropped = bool(
-                        self.fault_injector and
-                        self.fault_injector("commit", msg))
-                    if not dropped:
-                        self.ps.handle_commit(msg["delta"], msg)
-                    send_msg(conn, {"ok": True, "dropped": dropped})
-                elif action == "stop":
-                    send_msg(conn, {"ok": True})
-                    return
-                else:
-                    send_msg(conn, {"ok": False,
-                                    "error": f"unknown action {action!r}"})
+                self._g_inflight.inc()
+                try:
+                    if action == "pull":
+                        center, updates = self.ps.pull()
+                        send_msg(conn, {"center": center, "updates": updates},
+                                 registry=reg)
+                    elif action == "commit":
+                        dropped = bool(
+                            self.fault_injector and
+                            self.fault_injector("commit", msg))
+                        if not dropped:
+                            self.ps.handle_commit(msg["delta"], msg)
+                        else:
+                            self._c_dropped.inc()
+                        send_msg(conn, {"ok": True, "dropped": dropped},
+                                 registry=reg)
+                    elif action == "stats":
+                        send_msg(conn, self.ps.stats(), registry=reg)
+                    elif action == "stop":
+                        send_msg(conn, {"ok": True}, registry=reg)
+                        return
+                    else:
+                        send_msg(conn, {"ok": False,
+                                        "error": f"unknown action {action!r}"},
+                                 registry=reg)
+                finally:
+                    self._g_inflight.dec()
         finally:
             try:
                 conn.close()
@@ -255,3 +336,4 @@ class SocketParameterServer:
             with self._conn_lock:
                 if conn in self._conns:
                     self._conns.remove(conn)
+            self._g_conns.dec()

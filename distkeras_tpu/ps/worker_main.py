@@ -96,10 +96,10 @@ def run_spec(spec_path: str) -> None:
 
 
 def main(argv=None) -> int:
+    from ..obs import emit
     argv = argv if argv is not None else sys.argv[1:]
     if len(argv) != 1:
-        print("usage: python -m distkeras_tpu.ps.worker_main SPEC",
-              file=sys.stderr)
+        emit("usage: python -m distkeras_tpu.ps.worker_main SPEC", err=True)
         return 2
     try:
         run_spec(argv[0])

@@ -50,7 +50,7 @@ class AsyncWorker(threading.Thread):
     def __init__(self, worker_id: int, window_fn: Callable,
                  variables: Tree, opt_state: Tree, rng,
                  host: str, port: int, num_epoch: int,
-                 device=None, start_window: int = 0):
+                 device=None, start_window: int = 0, metrics=None):
         super().__init__(name=f"worker-{worker_id}", daemon=True)
         self.worker_id = worker_id
         self.window_fn = window_fn
@@ -61,6 +61,10 @@ class AsyncWorker(threading.Thread):
         self.ps_port = port
         self.num_epoch = num_epoch
         self.device = device
+        #: optional shared JSONL sink (``MetricsLogger`` — thread-safe):
+        #: one ``heartbeat`` record per committed window, so a stalled or
+        #: straggling worker is visible IN-RUN, not post-mortem (ISSUE 2)
+        self.metrics = metrics
         #: exact resume: global window index to continue from (= this
         #: worker's commit count in the restored PS snapshot; one commit
         #: per window).  0 on a fresh run.
@@ -113,6 +117,7 @@ class AsyncWorker(threading.Thread):
                     wy = self._put(self.ys[wi])
                     losses = self._window(client, wx, wy)
                     self.window_losses.append((gw, np.asarray(losses)))
+                    self._heartbeat(gw, n_windows)
         finally:
             # per-epoch view for the COMPLETE epochs this run covered —
             # built even on a crash so a retried worker's merge keeps the
@@ -145,10 +150,22 @@ class AsyncWorker(threading.Thread):
                     losses = self._window(client, self._put(wx),
                                           self._put(wy))
                     self.window_losses.append((gw, np.asarray(losses)))
+                    self._heartbeat(gw, n_windows)
                     gw += 1
             finally:
                 if hasattr(it, "close"):
                     it.close()
+
+    def _heartbeat(self, gw: int, n_windows: int) -> None:
+        """One liveness record per committed window into the shared sink.
+        The latest window's mean loss rides along so a live tail of the
+        JSONL shows progress AND health per worker."""
+        if self.metrics is None:
+            return
+        _, losses = self.window_losses[-1]
+        self.metrics.log("heartbeat", worker=self.worker_id, window=gw,
+                         epoch=gw // n_windows,
+                         mean_loss=float(np.mean(losses)))
 
     def _run_window(self, wx, wy):
         self.variables, self.opt_state, self.rng, losses = self.window_fn(

@@ -34,6 +34,7 @@ import optax
 from .data.dataset import Dataset
 from .models.layers import Activation, Dense, Sequential
 from .models.model import Model
+from .obs import SpanTracer
 from .ops.losses import get_loss, probs_loss_variant
 from .ops.optimizers import get_optimizer
 from .parallel import mesh as mesh_lib
@@ -177,6 +178,13 @@ class Trainer:
             self.metrics = metrics or MetricsLogger(None)
         else:
             self.metrics = MetricsLogger(metrics)
+        #: span tracer bound to the SAME sink as the metrics — traces and
+        #: per-epoch records interleave in one JSONL stream (ISSUE 2),
+        #: readable by ``scripts/obsview.py``
+        self.tracer = SpanTracer(self.metrics)
+        #: config keys whose jit program already ran once — the cold/warm
+        #: split behind the ``jit_compile`` span
+        self._compiled_keys: set = set()
 
         self.history: list = []
         self.training_time: float = 0.0
@@ -220,6 +228,25 @@ class Trainer:
                 self.learning_rate, str(self.compute_dtype), self.remat,
                 self.aux_weight)
 
+    def _instrumented(self, run, kind: str = "window"):
+        """Split first-call compile time from steady-state dispatch: the
+        first invocation of a freshly-built jit program (trace + XLA
+        compile happen synchronously inside that call) is recorded as a
+        ``jit_compile`` span in the metrics stream; warm calls dispatch in
+        microseconds and go unobserved.  Without the split, compile time
+        silently pollutes the first epoch's throughput number — exactly
+        the bias BASELINE round 5 tripped over."""
+        key = (kind, self._config_key())
+
+        def wrapped(*args):
+            if key not in self._compiled_keys:
+                self._compiled_keys.add(key)
+                with self.tracer.span("jit_compile", kind=kind,
+                                      trainer=type(self).__name__):
+                    return run(*args)
+            return run(*args)
+        return wrapped
+
     def _window_run(self):
         """Cached jit window program — repeated ``train()`` calls on an
         unchanged trainer reuse the compiled executable instead of
@@ -233,7 +260,8 @@ class Trainer:
                                  remat=self.remat,
                                  aux_weight=self.aux_weight)
             self._run_cache = (key, run, optimizer)
-        return self._run_cache[1:]
+        _, run, optimizer = self._run_cache
+        return self._instrumented(run), optimizer
 
     def _finish(self, variables) -> Model:
         self.trained_variables = jax.tree_util.tree_map(_to_host, variables)
@@ -251,7 +279,9 @@ class Trainer:
         t0 = time.time()
         self._resume = bool(resume)
         try:
-            return self._train(dataset, shuffle)
+            with self.tracer.span("train", trainer=type(self).__name__,
+                                  epochs=self.num_epoch):
+                return self._train(dataset, shuffle)
         finally:
             self.training_time = time.time() - t0
 
@@ -499,14 +529,15 @@ class DistributedTrainer(Trainer):
         engine, mesh, optimizer, programs = self._engine_parts()
         if "epoch" not in programs:
             programs["epoch"] = engine.epoch_fn()
-        return programs["epoch"], mesh, optimizer
+        return self._instrumented(programs["epoch"], "epoch"), mesh, optimizer
 
     def _engine_window(self):
         """Cached jit single-window program (streaming path)."""
         engine, mesh, optimizer, programs = self._engine_parts()
         if "window" not in programs:
             programs["window"] = engine.window_fn()
-        return programs["window"], mesh, optimizer
+        return (self._instrumented(programs["window"], "window"), mesh,
+                optimizer)
 
     def _train_sync(self, dataset: Dataset) -> Model:
         run, mesh, optimizer = self._engine_run()
@@ -793,6 +824,7 @@ class SpmdTrainer(Trainer):
         from .data.streaming import window_batches
         from .parallel import spmd
         run, optimizer, mesh, dp = self._window_run()
+        run = self._instrumented(run)
         bs = self.batch_size
         steps = source.steps_per_epoch(bs)
         if steps == 0:
@@ -904,8 +936,13 @@ class SpmdTrainer(Trainer):
             out_sh = (*carry_sh, mesh_lib.replicated(mesh))  # losses
             pinned = jax.jit(run, donate_argnums=(0, 1, 2),
                              out_shardings=out_sh)
-            self._aot_cache = (akey, pinned.lower(variables, opt_state, rng,
-                                                  xs, ys).compile())
+            # explicit AOT compile: the one place compile time is exactly
+            # measurable rather than inferred from a cold first step
+            with self.tracer.span("aot_compile",
+                                  trainer=type(self).__name__):
+                self._aot_cache = (akey,
+                                   pinned.lower(variables, opt_state, rng,
+                                                xs, ys).compile())
         compiled = self.compiled_step = self._aot_cache[1]
         samples = int(xs.shape[0]) * self.batch_size
         pipe = _EpochPipeline(self, samples)
@@ -1085,6 +1122,7 @@ class PipelineTrainer(Trainer):
                                  aux_weight=self.aux_weight)
             self._run_cache = (key, run, optimizer)
         run, optimizer = self._run_cache[1:]
+        run = self._instrumented(run)
 
         ds = dataset.coalesce(1)
         stacked_data, steps = ds.stacked([self.features_col, self.label_col],

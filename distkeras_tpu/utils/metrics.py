@@ -11,9 +11,64 @@ from __future__ import annotations
 import collections
 import contextlib
 import json
+import math
 import sys
+import threading
 import time
 from typing import IO, Optional, Union
+
+import numpy as np
+
+#: arrays at or below this many elements serialize as nested lists; larger
+#: ones as a shape/dtype/stats summary (a logged metric should never drag
+#: megabytes of weights into the JSONL stream)
+_ARRAY_INLINE_MAX = 64
+
+
+def json_safe(x):
+    """Coerce a logged value into strictly-valid JSON data.
+
+    ``json.dumps(default=float)`` raised on ``np.ndarray`` and emitted bare
+    ``NaN``/``Infinity`` tokens (invalid JSON — downstream parsers choke).
+    Rules: ndarrays become nested lists (small) or a summary dict (large);
+    numpy scalars become Python scalars; non-finite floats become the
+    strings ``"NaN"`` / ``"Infinity"`` / ``"-Infinity"``; anything exotic
+    falls back through ``np.asarray`` and finally ``str``.
+    """
+    if x is None or isinstance(x, (bool, int, str)):
+        return x
+    if isinstance(x, float):
+        if math.isfinite(x):
+            return x
+        if math.isnan(x):
+            return "NaN"
+        return "Infinity" if x > 0 else "-Infinity"
+    if isinstance(x, dict):
+        return {str(k): json_safe(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [json_safe(v) for v in x]
+    if isinstance(x, np.integer):
+        return int(x)
+    if isinstance(x, np.floating):
+        return json_safe(float(x))
+    if isinstance(x, np.bool_):
+        return bool(x)
+    if isinstance(x, np.ndarray):
+        if x.dtype == object:
+            return str(x)
+        if x.size <= _ARRAY_INLINE_MAX:
+            return json_safe(x.tolist())
+        out = {"shape": list(x.shape), "dtype": str(x.dtype)}
+        if x.size and np.issubdtype(x.dtype, np.number):
+            xf = np.asarray(x, dtype=np.float64)
+            out.update(mean=json_safe(float(xf.mean())),
+                       min=json_safe(float(xf.min())),
+                       max=json_safe(float(xf.max())))
+        return out
+    try:  # jax.Array and friends expose __array__
+        return json_safe(np.asarray(x))
+    except Exception:
+        return str(x)
 
 
 class MetricsLogger:
@@ -33,6 +88,9 @@ class MetricsLogger:
         self._own = False
         self.records: collections.deque = collections.deque(
             maxlen=keep_records)
+        #: async workers heartbeat from their own threads; one lock keeps
+        #: JSONL lines whole (interleaved writes would corrupt the stream)
+        self._lock = threading.Lock()
         if sink is None:
             self._fh = None
         elif isinstance(sink, str):
@@ -43,9 +101,15 @@ class MetricsLogger:
 
     def log(self, event: str, **fields) -> dict:
         rec = {"ts": time.time(), "event": event, **fields}
-        self.records.append(rec)
+        # raw values stay in .records (benchmarks read them back without a
+        # parse round-trip); only the serialized line is coerced
+        line = None
         if self._fh is not None:
-            self._fh.write(json.dumps(rec, default=float) + "\n")
+            line = json.dumps(json_safe(rec), allow_nan=False) + "\n"
+        with self._lock:
+            self.records.append(rec)
+            if line is not None:
+                self._fh.write(line)
         return rec
 
     def close(self) -> None:

@@ -1,0 +1,283 @@
+"""Run-inspection CLI for the telemetry stream (ISSUE 2).
+
+Two modes:
+
+* ``python scripts/obsview.py RUN.jsonl`` — read a JSONL metrics file (the
+  ``MetricsLogger`` sink a trainer wrote: epoch records, spans, async
+  heartbeats, the final ``ps_stats`` registry snapshot) and print a run
+  summary: per-epoch table, throughput timeline, staleness distribution,
+  top spans by cumulative time, per-worker heartbeat coverage.
+* ``python scripts/obsview.py --ps HOST:PORT`` — poll a LIVE
+  ``SocketParameterServer`` via its ``stats`` RPC and print the registry
+  snapshot (``--prometheus`` renders Prometheus text instead — pipe it
+  anywhere that scrapes the standard format).
+
+Everything renders through pure functions over plain records
+(``summarize`` / ``summarize_stats``) so tests — and notebooks — can call
+them directly on synthetic data.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, ROOT)
+
+from distkeras_tpu.obs import (  # noqa: E402
+    emit, snapshot_quantile, to_prometheus_text)
+
+_BLOCKS = " ▁▂▃▄▅▆▇█"
+
+#: MetricsLogger's json_safe coerces non-finite floats to these strings so
+#: the JSONL stays valid JSON; map them back when reading numbers
+_NONFINITE = {"NaN": float("nan"), "Infinity": float("inf"),
+              "-Infinity": float("-inf")}
+
+
+def _num(v, default=float("nan")) -> float:
+    """Record field -> float, tolerating the json_safe string coercions
+    and anything else hostile (a diagnostic tool must not crash on the
+    pathological runs it exists to inspect)."""
+    if isinstance(v, str):
+        v = _NONFINITE.get(v, v)
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return default
+
+
+def load_records(path: str) -> list:
+    """JSONL file -> list of record dicts (blank lines skipped)."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return records
+
+
+def _sparkline(values) -> str:
+    """Tiny unicode bar chart — the throughput timeline at a glance."""
+    vals = [_num(v, 0.0) for v in values]
+    vals = [0.0 if math.isnan(v) or math.isinf(v) else v for v in vals]
+    if not vals:
+        return ""
+    hi = max(vals)
+    if hi <= 0:
+        return _BLOCKS[0] * len(vals)
+    return "".join(_BLOCKS[min(8, int(round(v / hi * 8)))] for v in vals)
+
+
+def _fmt_seconds(s: float) -> str:
+    if s >= 1.0:
+        return f"{s:.2f}s"
+    if s >= 1e-3:
+        return f"{s * 1e3:.1f}ms"
+    return f"{s * 1e6:.0f}µs"
+
+
+def _epoch_table(epochs: list) -> list:
+    lines = ["== Per-epoch ==",
+             f"{'epoch':>5}  {'trainer':<22} {'mean_loss':>10}  "
+             f"{'seconds':>8}  {'samples/sec':>12}"]
+    for r in epochs:
+        loss = _num(r.get("mean_loss"))
+        rate = _num(r.get("samples_per_sec"), 0.0)
+        rate_s = f"{rate:>12,.0f}" if math.isfinite(rate) else f"{rate:>12}"
+        lines.append(
+            f"{r.get('epoch', '?'):>5}  {r.get('trainer', '?'):<22} "
+            f"{loss:>10.4f}  "
+            f"{_num(r.get('epoch_seconds'), 0.0):>8.2f}  " + rate_s)
+    return lines
+
+
+def _staleness_lines(hist: dict) -> list:
+    lines = ["== Staleness distribution =="]
+    count = hist.get("count", 0)
+    if not count:
+        return lines + ["(no staleness observations)"]
+    lines.append(f"commits: {count}   mean: "
+                 f"{hist['sum'] / count:.2f}   p50: "
+                 f"{snapshot_quantile(hist, 0.5):.1f}   p90: "
+                 f"{snapshot_quantile(hist, 0.9):.1f}   p99: "
+                 f"{snapshot_quantile(hist, 0.99):.1f}")
+    bounds = list(hist["bounds"]) + [float("inf")]
+    width = max(1, max(hist["counts"]))
+    for bound, c in zip(bounds, hist["counts"]):
+        if c:
+            label = f"<= {bound:g}" if bound != float("inf") \
+                else f"> {bounds[-2]:g}"
+            bar = "#" * max(1, round(c / width * 40))
+            lines.append(f"{label:>10}  {c:>8}  {bar}")
+    return lines
+
+
+def _top_spans(spans: list, top: int = 10) -> list:
+    lines = ["== Top spans by cumulative time ==",
+             f"{'span':<24} {'count':>6}  {'total':>10}  {'mean':>10}"]
+    agg: dict = {}
+    for s in spans:
+        name = s.get("name", "?")
+        tot, n = agg.get(name, (0.0, 0))
+        agg[name] = (tot + float(s.get("seconds", 0.0)), n + 1)
+    for name, (tot, n) in sorted(agg.items(), key=lambda kv: -kv[1][0])[:top]:
+        lines.append(f"{name:<24} {n:>6}  {_fmt_seconds(tot):>10}  "
+                     f"{_fmt_seconds(tot / n):>10}")
+    return lines
+
+
+def _heartbeat_lines(heartbeats: list) -> list:
+    by_worker: dict = {}
+    for h in heartbeats:
+        w = h.get("worker", "?")
+        cur = by_worker.setdefault(w, {"n": 0, "last_window": -1,
+                                       "last_ts": 0.0})
+        cur["n"] += 1
+        cur["last_window"] = max(cur["last_window"], h.get("window", -1))
+        cur["last_ts"] = max(cur["last_ts"], h.get("ts", 0.0))
+    lines = ["== Worker heartbeats ==",
+             f"{'worker':>6}  {'beats':>6}  {'last window':>12}"]
+    for w in sorted(by_worker):
+        cur = by_worker[w]
+        lines.append(f"{w:>6}  {cur['n']:>6}  {cur['last_window']:>12}")
+    return lines
+
+
+def summarize(records: list) -> str:
+    """Full-run summary from a JSONL record list — the file mode's body."""
+    epochs = [r for r in records if r.get("event") == "epoch"]
+    spans = [r for r in records if r.get("event") == "span"]
+    heartbeats = [r for r in records if r.get("event") == "heartbeat"]
+    ps_stats = [r for r in records if r.get("event") == "ps_stats"]
+
+    sections = []
+    if epochs:
+        sections.append(_epoch_table(epochs))
+        rates = [_num(r.get("samples_per_sec"), 0.0) for r in epochs]
+        finite = [r for r in rates if math.isfinite(r)] or [0.0]
+        sections.append(["== Throughput timeline ==",
+                         f"[{_sparkline(rates)}]  "
+                         f"min {min(finite):,.0f}  max {max(finite):,.0f} "
+                         f"samples/sec over {len(rates)} epochs"])
+    else:
+        sections.append(["== Per-epoch ==", "(no epoch records)"])
+
+    # staleness: prefer the final ps_stats registry snapshot (complete,
+    # bounded-memory histogram) — the PS path's defining distribution
+    stats = ps_stats[-1].get("stats", {}) if ps_stats else {}
+    if "ps.staleness" in stats:
+        sections.append(_staleness_lines(stats["ps.staleness"]))
+        per_worker = {k: v for k, v in stats.items()
+                      if k.startswith("ps.staleness.worker")}
+        if per_worker:
+            lines = ["== Per-worker staleness (mean) =="]
+            for k in sorted(per_worker):
+                h = per_worker[k]
+                mean = h["sum"] / h["count"] if h["count"] else 0.0
+                lines.append(f"{k.rsplit('worker', 1)[1]:>6}  "
+                             f"n={h['count']:<6}  mean {mean:.2f}")
+            sections.append(lines)
+    if ps_stats:
+        last = ps_stats[-1]
+        lines = ["== Parameter server =="]
+        lines.append(f"updates: {last.get('num_updates')}   "
+                     f"commits_by_worker: {last.get('commits_by_worker')}")
+        for key, label in (("ps.commits", "commits"), ("ps.pulls", "pulls"),
+                           ("ps.commits_dropped", "dropped"),
+                           ("net.bytes_sent", "bytes_sent"),
+                           ("net.bytes_recv", "bytes_recv")):
+            if key in stats:
+                lines.append(f"{label:>12}: {stats[key]['value']:,.0f}")
+        if "ps.apply_seconds" in stats:
+            h = stats["ps.apply_seconds"]
+            if h["count"]:
+                lines.append(
+                    f"{'apply':>12}: mean "
+                    f"{_fmt_seconds(h['sum'] / h['count'])}  p99 "
+                    f"{_fmt_seconds(snapshot_quantile(h, 0.99))}")
+        sections.append(lines)
+    if spans:
+        sections.append(_top_spans(spans))
+    if heartbeats:
+        sections.append(_heartbeat_lines(heartbeats))
+
+    return "\n".join("\n".join(s) for s in sections if s)
+
+
+def summarize_stats(reply: dict) -> str:
+    """Live-poll summary from a ``stats`` RPC reply."""
+    stats = reply.get("stats", {})
+    lines = [f"== Live PS ({reply.get('server', '?')}, "
+             f"{reply.get('num_workers', '?')} workers) ==",
+             f"updates: {reply.get('num_updates')}   commits_by_worker: "
+             f"{reply.get('commits_by_worker')}"]
+    for name in sorted(stats):
+        s = stats[name]
+        if s["type"] == "histogram":
+            if s["count"]:
+                lines.append(
+                    f"{name}: n={s['count']} mean="
+                    f"{s['sum'] / s['count']:.4g} "
+                    f"p50={snapshot_quantile(s, 0.5):.4g} "
+                    f"p99={snapshot_quantile(s, 0.99):.4g}")
+            else:
+                lines.append(f"{name}: n=0")
+        else:
+            lines.append(f"{name}: {s['value']:g}")
+    if "ps.staleness" in stats:
+        lines.append("")
+        lines.extend(_staleness_lines(stats["ps.staleness"]))
+    return "\n".join(lines)
+
+
+def poll_stats(host: str, port: int) -> dict:
+    from distkeras_tpu.ps.client import PSClient
+    with PSClient(host, int(port)) as client:
+        return client.stats()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="inspect a telemetry JSONL file or poll a live PS")
+    ap.add_argument("jsonl", nargs="?",
+                    help="JSONL metrics file written by MetricsLogger")
+    ap.add_argument("--ps", metavar="HOST:PORT",
+                    help="poll a live SocketParameterServer's stats RPC")
+    ap.add_argument("--prometheus", action="store_true",
+                    help="with --ps (or a ps_stats record): render the "
+                         "registry snapshot as Prometheus text")
+    args = ap.parse_args(argv)
+
+    if bool(args.jsonl) == bool(args.ps):
+        ap.error("need exactly one of JSONL or --ps")
+
+    if args.ps:
+        host, _, port = args.ps.rpartition(":")
+        if not host or not port.isdigit():
+            ap.error(f"--ps expects HOST:PORT, got {args.ps!r}")
+        reply = poll_stats(host, int(port))
+        emit(to_prometheus_text(reply.get("stats", {})) if args.prometheus
+             else summarize_stats(reply))
+        return 0
+
+    records = load_records(args.jsonl)
+    if args.prometheus:
+        ps_stats = [r for r in records if r.get("event") == "ps_stats"]
+        if not ps_stats:
+            emit("no ps_stats record in stream", err=True)
+            return 1
+        emit(to_prometheus_text(ps_stats[-1].get("stats", {})))
+        return 0
+    emit(summarize(records))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
