@@ -1,0 +1,29 @@
+"""dklint — static analysis + runtime race checking for this stack
+(ISSUE 3 tentpole).
+
+An asynchronous parameter-server stack is exactly the shape of code where
+Python-side hazards corrupt training without failing a test: a
+``time.time()`` traced into a jit program is one frozen constant, an
+instance attribute written outside the mutex is a silent lost update, a
+bare ``except:`` turns a wire error into NaN weights three epochs later.
+PR 2 proved the pattern with a one-off AST gate for ``print(``; this
+package generalizes it:
+
+* ``core``      — rule/finding framework, inline-pragma + baseline
+  suppression, the ``run_paths`` driver.
+* ``rules``     — the repo-specific rule set (jit-purity,
+  lock-discipline, swallow-guard, thread-shutdown, bare-print).
+* ``racecheck`` — opt-in runtime proxies (tracked locks + guarded dicts)
+  that fail threaded tests on unguarded shared-state writes.
+* ``cli``       — the ``dklint`` console entry point
+  (``scripts/dklint.py`` wraps it).
+
+The tier-1 gate (``tests/test_analysis.py::test_repo_is_dklint_clean``)
+runs the full rule set over ``distkeras_tpu/`` — any new finding fails
+the build unless deliberately suppressed.
+"""
+
+from .core import (  # noqa: F401
+    FileContext, Finding, Report, Rule, analyze_source, apply_baseline,
+    iter_py_files, load_baseline, run_paths, write_baseline)
+from .rules import ALL_RULES, RULES_BY_ID  # noqa: F401

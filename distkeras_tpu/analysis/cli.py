@@ -1,0 +1,158 @@
+"""dklint command line — ``dklint [paths...]`` (console entry point) or
+``python scripts/dklint.py [paths...]``.
+
+Exit codes: 0 = clean (no unsuppressed findings), 1 = findings,
+2 = usage/IO error.  ``--format json`` emits a machine-readable report;
+``--write-baseline`` accepts the current findings as debt (see
+``core.write_baseline``).  With no ``--baseline`` flag, the nearest
+``dklint_baseline.json`` above the scanned paths (or cwd) is picked up
+automatically, so the committed baseline is honored no matter which
+directory ``dklint`` runs from.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from ..obs.logging import emit
+from . import core
+from .rules import ALL_RULES, RULES_BY_ID
+
+_DEFAULT_BASELINE = "dklint_baseline.json"
+
+
+def _discover_baseline(paths: List[str]) -> Optional[str]:
+    """Nearest ``dklint_baseline.json`` above the scanned paths (falling
+    back to cwd): running the installed ``dklint`` from any directory
+    still honors the scanned repo's committed baseline.  Paths come
+    first — the caller's cwd may sit in a DIFFERENT repo whose baseline
+    must not shadow the target's."""
+    for start in [p for p in paths if os.path.exists(p)] + [os.getcwd()]:
+        anchor = core.find_anchor(start)
+        while anchor is not None:
+            cand = os.path.join(anchor, _DEFAULT_BASELINE)
+            if os.path.exists(cand):
+                return cand
+            parent = os.path.dirname(anchor)
+            anchor = core.find_anchor(parent) if parent != anchor else None
+    return None
+
+
+def _select_rules(spec: Optional[str]) -> List[core.Rule]:
+    if not spec:
+        return list(ALL_RULES)
+    rules = []
+    for rid in (s.strip() for s in spec.split(",") if s.strip()):
+        if rid not in RULES_BY_ID:
+            raise KeyError(rid)
+        rules.append(RULES_BY_ID[rid])
+    return rules
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dklint",
+        description="static analysis for the distkeras_tpu stack "
+                    "(jit-purity, lock-discipline, swallow-guard, "
+                    "thread-shutdown, bare-print)")
+    ap.add_argument("paths", nargs="*", default=["distkeras_tpu"],
+                    help="files/directories to analyze "
+                         "(default: distkeras_tpu)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--rules", metavar="ID[,ID...]",
+                    help="run only these rules (default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help=f"suppression baseline (default: "
+                         f"./{_DEFAULT_BASELINE} when present)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept the current findings as the baseline "
+                         "and write them to the baseline file")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            emit(f"{r.id:16s} {r.description}")
+        return 0
+
+    try:
+        rules = _select_rules(args.rules)
+    except KeyError as e:
+        emit(f"dklint: unknown rule {e.args[0]!r} "
+             f"(known: {', '.join(sorted(RULES_BY_ID))})", err=True)
+        return 2
+
+    baseline_path = args.baseline
+    if baseline_path is None:
+        baseline_path = _discover_baseline(args.paths)
+
+    write_target = None
+    bootstrap = None
+    if args.write_baseline and args.rules:
+        # a subset run would overwrite the baseline with only ITS
+        # findings, silently dropping every other rule's accepted debt
+        emit("dklint: --write-baseline requires the full rule set "
+             "(drop --rules)", err=True)
+        return 2
+    if args.write_baseline:
+        write_target = args.baseline or baseline_path or _DEFAULT_BASELINE
+        if not os.path.exists(write_target):
+            # create it BEFORE scanning: the baseline file is itself an
+            # anchor marker, so the fingerprints it stores must be
+            # computed with it in place (first-write bootstrap)
+            core.write_baseline(write_target, [])
+            bootstrap = write_target
+
+    report = core.run_paths(args.paths, rules=rules)
+    if report.errors:
+        if bootstrap is not None:
+            # don't leave a stray empty baseline behind on a failed run —
+            # as an anchor marker it would re-root future fingerprints
+            try:
+                os.unlink(bootstrap)
+            except OSError:
+                pass
+        for path, msg in report.errors:
+            emit(f"dklint: {path}: {msg}", err=True)
+        return 2
+
+    if write_target is not None:
+        core.write_baseline(write_target, report.findings)
+        emit(f"dklint: wrote {len(report.findings)} finding(s) to "
+             f"{write_target}")
+        return 0
+
+    if baseline_path is not None:
+        try:
+            core.apply_baseline(report, core.load_baseline(baseline_path))
+        except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+            emit(f"dklint: bad baseline {baseline_path}: {e}", err=True)
+            return 2
+
+    if args.format == "json":
+        emit(json.dumps({
+            "findings": [f.as_dict() for f in report.findings],
+            "suppressed": {
+                "inline": len(report.inline_suppressed),
+                "baseline": len(report.baseline_suppressed),
+            },
+        }, indent=2))
+    else:
+        for f in report.findings:
+            emit(f"{f.location()}: [{f.rule}] {f.message}")
+            if f.snippet:
+                emit(f"    {f.snippet}")
+        n = len(report.findings)
+        supp = len(report.inline_suppressed) + len(report.baseline_suppressed)
+        tail = f" ({supp} suppressed)" if supp else ""
+        emit(f"dklint: {n} finding(s){tail}")
+    return 1 if report.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

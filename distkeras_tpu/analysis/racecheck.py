@@ -1,0 +1,255 @@
+"""Runtime race detector for the PS stack — dklint's dynamic half.
+
+The static ``lock-discipline`` rule reasons lexically; this module checks
+the same discipline at runtime on REAL thread interleavings.  Opt-in
+(``DKLINT_RACECHECK=1`` + the autouse pytest fixture in
+``tests/conftest.py``), zero overhead when disabled.
+
+Mechanics (a write-focused lockset check, in the Eraser family):
+
+* ``TrackedLock`` wraps a ``threading.Lock`` and records which threads
+  currently hold it (re-entrant bookkeeping, so an RLock upgrade keeps
+  working).
+* ``GuardedDict`` subclasses ``dict``; every mutation checks the guard.
+  A mutation WITHOUT the guard held is a violation once the dict has been
+  touched by more than one thread — single-threaded setup/teardown stays
+  legal (construction and post-join reads have a happens-before edge the
+  detector cannot see, so reads are recorded but never flagged).
+* ``install()`` monkeypatches ``ParameterServer.__init__`` so every PS
+  built afterwards gets a tracked mutex and a guarded
+  ``commits_by_worker`` — the shared dict every commit path writes.
+  ``enabled()`` is the context-manager form tests use.
+
+Violations land in a process-global list (thread-safe) with the dict
+name, key, thread and stack snippet — ``violations()`` / ``reset()``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import traceback
+from typing import Any, Dict, List, Optional
+
+ENV_VAR = "DKLINT_RACECHECK"
+
+_VIOLATIONS: List[dict] = []
+_VLOCK = threading.Lock()
+
+
+def violations() -> List[dict]:
+    """Snapshot of recorded unguarded-access violations."""
+    with _VLOCK:
+        return list(_VIOLATIONS)
+
+
+def reset() -> None:
+    with _VLOCK:
+        _VIOLATIONS.clear()
+
+
+def _record_violation(name: str, op: str, key: Any) -> None:
+    # drop the two racecheck frames; keep the caller's context
+    stack = "".join(traceback.format_stack(limit=8)[:-2])
+    with _VLOCK:
+        _VIOLATIONS.append({
+            "dict": name, "op": op, "key": key,
+            "thread": threading.current_thread().name,
+            "stack": stack,
+        })
+
+
+def enabled_by_env() -> bool:
+    return bool(os.environ.get(ENV_VAR))
+
+
+class TrackedLock:
+    """Lock proxy that knows which threads currently hold it."""
+
+    def __init__(self, lock: Optional[threading.Lock] = None):
+        self._lock = lock if lock is not None else threading.Lock()
+        self._meta = threading.Lock()
+        self._holders: Dict[int, int] = {}  # thread id -> depth
+
+    def acquire(self, *args, **kwargs) -> bool:
+        got = self._lock.acquire(*args, **kwargs)
+        if got:
+            tid = threading.get_ident()
+            with self._meta:
+                self._holders[tid] = self._holders.get(tid, 0) + 1
+        return got
+
+    def release(self) -> None:
+        tid = threading.get_ident()
+        with self._meta:
+            depth = self._holders.get(tid, 0)
+            if depth <= 1:
+                self._holders.pop(tid, None)
+            else:
+                self._holders[tid] = depth - 1
+        self._lock.release()
+
+    def held_by_current_thread(self) -> bool:
+        return threading.get_ident() in self._holders
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "TrackedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class GuardedDict(dict):
+    """dict that requires ``guard`` to be held for mutations once the
+    dict is shared across threads.  Reads record thread participation
+    only (post-join single-thread reads are legal and common)."""
+
+    def __init__(self, guard: TrackedLock, name: str, data=()):
+        super().__init__(data)
+        self._guard = guard
+        self._name = name
+        self._threads: set = set()
+        self._threads.add(threading.get_ident())
+
+    def _touch(self, op: str, key: Any, write: bool) -> None:
+        tid = threading.get_ident()
+        self._threads.add(tid)  # GIL-atomic set.add
+        if write and len(self._threads) > 1 and \
+                not self._guard.held_by_current_thread():
+            _record_violation(self._name, op, key)
+
+    # -- reads (participation only) ----------------------------------------
+    def __getitem__(self, key):
+        self._touch("getitem", key, write=False)
+        return super().__getitem__(key)
+
+    def get(self, key, default=None):
+        self._touch("get", key, write=False)
+        return super().get(key, default)
+
+    # -- writes (checked) ---------------------------------------------------
+    def __setitem__(self, key, value):
+        self._touch("setitem", key, write=True)
+        super().__setitem__(key, value)
+
+    def __delitem__(self, key):
+        self._touch("delitem", key, write=True)
+        super().__delitem__(key)
+
+    def pop(self, key, *default):
+        self._touch("pop", key, write=True)
+        return super().pop(key, *default)
+
+    def popitem(self):
+        self._touch("popitem", None, write=True)
+        return super().popitem()
+
+    def clear(self):
+        self._touch("clear", None, write=True)
+        super().clear()
+
+    def update(self, *args, **kwargs):
+        self._touch("update", None, write=True)
+        super().update(*args, **kwargs)
+
+    def setdefault(self, key, default=None):
+        self._touch("setdefault", key, write=True)
+        return super().setdefault(key, default)
+
+
+def wrap_ps(ps) -> None:
+    """Instrument one already-built ParameterServer in place: tracked
+    mutex + guarded shared dicts (idempotent)."""
+    if not isinstance(ps.mutex, TrackedLock):
+        ps.mutex = TrackedLock(ps.mutex)
+    name = type(ps).__name__
+    if not isinstance(ps.commits_by_worker, GuardedDict):
+        ps.commits_by_worker = GuardedDict(
+            ps.mutex, f"{name}.commits_by_worker", ps.commits_by_worker)
+    by_worker = getattr(ps, "_h_by_worker", None)
+    if by_worker is not None and not isinstance(by_worker, GuardedDict):
+        ps._h_by_worker = GuardedDict(ps.mutex, f"{name}._h_by_worker",
+                                      by_worker)
+
+
+def installed() -> bool:
+    from ..ps import servers
+    return bool(getattr(servers.ParameterServer, "_dklint_racecheck", False))
+
+
+def install():
+    """Monkeypatch every PS ``__init__`` in ``ps.servers`` so each server
+    constructed from now on is racechecked.  Patching only the base class
+    would wrap BEFORE subclass bodies run (``DynSGDParameterServer``
+    creates ``_h_by_worker`` after ``super().__init__``), leaving that
+    dict unguarded — so every class in the hierarchy that defines its own
+    ``__init__`` is patched and ``wrap_ps`` stays idempotent.  Returns an
+    ``uninstall()`` callable."""
+    import inspect
+
+    from ..ps import servers
+
+    if installed():
+        return lambda: None  # already installed (nested enables)
+
+    targets = [
+        cls for _, cls in inspect.getmembers(servers, inspect.isclass)
+        if issubclass(cls, servers.ParameterServer) and
+        "__init__" in vars(cls)
+    ] or [servers.ParameterServer]
+    originals = []
+    for cls in targets:
+        orig_init = cls.__init__
+
+        def patched_init(self, *args, _orig=orig_init, **kwargs):
+            _orig(self, *args, **kwargs)
+            wrap_ps(self)
+
+        cls.__init__ = patched_init
+        originals.append((cls, "__init__", orig_init))
+    # methods that REBIND guarded attributes (restore() replaces
+    # commits_by_worker with a plain dict) must re-wrap afterwards, or
+    # detection silently dies for the rest of the run
+    for name in ("restore",):
+        orig_m = getattr(servers.ParameterServer, name)
+
+        def rewrapped(self, *args, _orig=orig_m, **kwargs):
+            out = _orig(self, *args, **kwargs)
+            wrap_ps(self)
+            return out
+
+        setattr(servers.ParameterServer, name, rewrapped)
+        originals.append((servers.ParameterServer, name, orig_m))
+    servers.ParameterServer._dklint_racecheck = True
+
+    def uninstall():
+        for cls, name, orig in originals:
+            setattr(cls, name, orig)
+        servers.ParameterServer._dklint_racecheck = False
+
+    return uninstall
+
+
+@contextlib.contextmanager
+def enabled():
+    """``with racecheck.enabled() as viol:`` — installs the PS proxies,
+    yields the live violations list, uninstalls on exit.  The caller
+    asserts ``not viol`` (the conftest fixture does exactly this).
+
+    The violation list is scoped to the block: reset on entry AND on
+    exit, so a test that deliberately seeds a violation inside a nested
+    ``enabled()`` cannot leak it into an outer collector (the autouse
+    fixture under ``DKLINT_RACECHECK=1``) and fail teardown spuriously.
+    Assert on the yielded list before the block closes."""
+    reset()
+    uninstall = install()
+    try:
+        yield _VIOLATIONS
+    finally:
+        uninstall()
+        reset()

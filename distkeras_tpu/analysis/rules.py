@@ -1,0 +1,507 @@
+"""dklint rules — repo-specific static checks for a distributed-JAX stack.
+
+Five rules, each targeting a hazard class this codebase actually has
+(ISSUE 3; the PS stack is exactly the shape of code where these corrupt
+training without failing a test):
+
+* ``jit-purity``      — Python side effects / host syncs inside functions
+  that are jit-traced (``time.time()``, global-state ``np.random.*``,
+  ``.item()``, ``float()``, ``np.asarray``, ``block_until_ready``, ...).
+  Traced code runs ONCE at trace time; a side effect there silently bakes
+  one stale value into the compiled program.
+* ``lock-discipline`` — for classes owning a ``threading.Lock``, instance
+  attributes written both under ``with <lock>`` and bare.  A method whose
+  contract is "called with the lock held" declares it with a
+  ``# dklint: holds=<lock>`` pragma on its ``def`` line.
+* ``swallow-guard``   — catch-all handlers (``except:`` /
+  ``except Exception:``) that neither re-raise, nor use the bound
+  exception, nor log: the silent-corruption classic.
+* ``thread-shutdown`` — daemon threads spawned in a scope with no stop
+  event and no ``join()``: work that dies mid-write at interpreter exit.
+* ``bare-print``      — ``print(`` in library code (output goes through
+  ``obs.logging``'s ``emit``/``get_logger`` seam); migrated here from
+  the one-off AST gate PR 2 shipped in ``tests/test_obs.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import FileContext, Finding, Rule
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Name/Attribute chain -> dotted string (``jax.jit``), else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _terminal(node: ast.AST) -> Optional[str]:
+    """Last component of a Name/Attribute chain (``jax.jit`` -> ``jit``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` -> ``X``, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+# ---------------------------------------------------------------------------
+# jit-purity
+# ---------------------------------------------------------------------------
+
+#: transforms whose function argument gets traced (first positional arg or
+#: decorator target); ``scan`` covers ``lax.scan(body, ...)`` bodies
+_TRACE_NAMES = {"jit", "pjit", "pmap", "vmap", "grad", "value_and_grad",
+                "shard_map", "checkpoint", "remat", "scan"}
+
+#: ``time.X()`` calls that read host clocks / sleep
+_TIME_FNS = {"time", "time_ns", "perf_counter", "perf_counter_ns",
+             "monotonic", "monotonic_ns", "process_time", "sleep"}
+
+#: ``np.X()`` host materialization / IO
+_NP_HOST = {"asarray", "array", "save", "savez", "savez_compressed", "load"}
+
+#: method calls that force a device->host sync
+_SYNC_METHODS = {"item", "tolist", "block_until_ready", "numpy"}
+
+#: builtins that concretize a traced value on the host
+_CAST_BUILTINS = {"float", "int", "bool"}
+
+
+def _is_trace_transform(node: ast.AST) -> bool:
+    """True for ``jax.jit`` / ``jit`` / ``functools.partial(jax.jit, ...)``
+    / ``jax.jit(static_argnums=...)`` decorator expressions."""
+    if _terminal(node) in _TRACE_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        if _terminal(node.func) == "partial" and node.args and \
+                _terminal(node.args[0]) in _TRACE_NAMES:
+            return True
+        if _terminal(node.func) in _TRACE_NAMES:
+            return True
+    return False
+
+
+class JitPurityRule(Rule):
+    id = "jit-purity"
+    description = ("side effects / host syncs inside jit-traced functions "
+                   "(run once at trace time, then baked into the program)")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        defs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+
+        traced: List[ast.AST] = []
+        seen_ids: Set[int] = set()
+
+        def mark(fn: ast.AST) -> None:
+            if id(fn) not in seen_ids:
+                seen_ids.add(id(fn))
+                traced.append(fn)
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(_is_trace_transform(d) for d in node.decorator_list):
+                    mark(node)
+            elif isinstance(node, ast.Call) and \
+                    _terminal(node.func) in _TRACE_NAMES and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Name):
+                    for fn in defs.get(arg.id, []):
+                        mark(fn)
+
+        findings: List[Finding] = []
+        flagged: Set[Tuple[int, int]] = set()
+
+        def flag(node: ast.AST, what: str) -> None:
+            key = (node.lineno, node.col_offset)
+            if key in flagged:
+                return
+            flagged.add(key)
+            findings.append(self.finding(
+                ctx, node, f"{what} inside a jit-traced function (runs "
+                           f"once at trace time, not per step)"))
+
+        for fn in traced:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = _dotted(node.func) or ""
+                parts = dotted.split(".")
+                term = parts[-1] if parts else ""
+                # time.time() and friends
+                if len(parts) == 2 and parts[0] == "time" and \
+                        term in _TIME_FNS:
+                    flag(node, f"host clock call `{dotted}()`")
+                # np.random.* global-state RNG (default_rng is the seeded,
+                # object-based API — still host-side, but flagged as a
+                # host materialization only when its output is consumed)
+                elif len(parts) == 3 and parts[0] in ("np", "numpy") and \
+                        parts[1] == "random" and term != "default_rng":
+                    flag(node, f"global-state RNG `{dotted}()` (use "
+                               f"jax.random with an explicit key)")
+                # np.asarray / np.array / np IO — host materialization
+                elif len(parts) == 2 and parts[0] in ("np", "numpy") and \
+                        term in _NP_HOST:
+                    flag(node, f"host materialization `{dotted}()` (use "
+                               f"jnp inside traced code)")
+                # .item() / .block_until_ready() / .tolist() / .numpy() —
+                # checked on node.func.attr, not the dotted chain: the
+                # common shapes (`loss.mean().item()`,
+                # `state['loss'].item()`) have Call/Subscript receivers
+                # that don't form a Name chain
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _SYNC_METHODS and not node.args:
+                    flag(node, f"device->host sync `.{node.func.attr}()`")
+                # float(x) / int(x) / bool(x) on non-literals
+                elif isinstance(node.func, ast.Name) and \
+                        term in _CAST_BUILTINS and node.args and \
+                        not isinstance(node.args[0], ast.Constant):
+                    flag(node, f"host concretization `{term}(...)`")
+                elif isinstance(node.func, ast.Name) and term == "print":
+                    flag(node, "print() side effect")
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+#: container methods that mutate their receiver
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "popitem",
+             "popleft", "appendleft", "clear", "update", "setdefault",
+             "add", "discard", "sort", "reverse"}
+
+_LOCK_CTORS = {"Lock", "RLock"}
+
+
+class _ClassRecord:
+    """Per-class write ledger: attr -> write sites split by lock state."""
+
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.bases = [_terminal(b) for b in node.bases]
+        self.locks: Set[str] = set()
+        #: attr -> lock names it was written under
+        self.inside: Dict[str, Set[str]] = {}
+        #: attr -> [(write node, method name)] for unguarded writes
+        self.outside: Dict[str, List[Tuple[ast.AST, str]]] = {}
+
+
+class LockDisciplineRule(Rule):
+    id = "lock-discipline"
+    description = ("instance attributes written both under `with <lock>` "
+                   "and bare, in classes that own a threading.Lock")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        classes: Dict[str, _ClassRecord] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                classes[node.name] = _ClassRecord(node)
+
+        for rec in classes.values():
+            for node in ast.walk(rec.node):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr and isinstance(getattr(node, "value", None),
+                                           ast.Call) and \
+                            _terminal(node.value.func) in _LOCK_CTORS:
+                        rec.locks.add(attr)
+
+        def chain_locks(rec: _ClassRecord, depth: int = 0) -> Set[str]:
+            locks = set(rec.locks)
+            if depth < 8:  # defensive bound on malformed hierarchies
+                for b in rec.bases:
+                    if b in classes:
+                        locks |= chain_locks(classes[b], depth + 1)
+            return locks
+
+        for rec in classes.values():
+            locks = chain_locks(rec)
+            if not locks:
+                continue
+            for item in rec.node.body:
+                if not isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if item.name == "__init__":
+                    continue  # construction happens-before every thread
+                self._scan(rec, item, locks,
+                           held=set(ctx.holds(item.lineno)))
+
+        findings: List[Finding] = []
+
+        def chain_inside(rec: _ClassRecord,
+                         depth: int = 0) -> Dict[str, Set[str]]:
+            """attr -> lock names it is written under, across the local
+            class hierarchy (a subclass writing bare to an attribute the
+            base guards is exactly the bug this rule exists for)."""
+            out: Dict[str, Set[str]] = {}
+            if depth < 8:
+                for b in rec.bases:
+                    if b in classes:
+                        for a, ls in chain_inside(classes[b],
+                                                  depth + 1).items():
+                            out.setdefault(a, set()).update(ls)
+            for a, ls in rec.inside.items():
+                out.setdefault(a, set()).update(ls)
+            return out
+
+        for rec in classes.values():
+            if not chain_locks(rec):
+                continue
+            guarded = chain_inside(rec)
+            for attr, sites in sorted(rec.outside.items()):
+                if attr not in guarded:
+                    continue
+                locks_txt = ",".join(sorted(guarded[attr])) or \
+                    ",".join(sorted(chain_locks(rec)))
+                for node, method in sites:
+                    findings.append(self.finding(
+                        ctx, node,
+                        f"`self.{attr}` written in `{rec.node.name}."
+                        f"{method}` without holding `self.{locks_txt}` "
+                        f"(written under the lock elsewhere); guard it, "
+                        f"or declare the contract with "
+                        f"`# dklint: holds={locks_txt}`"))
+        return findings
+
+    def _scan(self, rec: _ClassRecord, method: ast.AST, locks: Set[str],
+              held: Set[str]) -> None:
+        """Walk one method body tracking which owned locks are lexically
+        held; record every self-attribute write on the proper side."""
+
+        def record(node: ast.AST, attr: str, held_now: Set[str]) -> None:
+            if attr in locks:
+                return  # rebinding the lock itself is not data
+            if held_now:
+                rec.inside.setdefault(attr, set()).update(held_now)
+            else:
+                rec.outside.setdefault(attr, []).append((node, method.name))
+
+        def write_targets(node: ast.AST) -> List[str]:
+            attrs = []
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets = [node.target]
+            for t in targets:
+                elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                for e in elts:
+                    a = _self_attr(e)
+                    if a:
+                        attrs.append(a)
+                    elif isinstance(e, ast.Subscript):
+                        a = _self_attr(e.value)
+                        if a:
+                            attrs.append(a)
+            return attrs
+
+        def visit(node: ast.AST, held_now: Set[str]) -> None:
+            if isinstance(node, ast.With):
+                acquired = set()
+                for item in node.items:
+                    a = _self_attr(item.context_expr)
+                    if a in locks:
+                        acquired.add(a)
+                for child in node.body:
+                    visit(child, held_now | acquired)
+                return
+            for attr in write_targets(node):
+                record(node, attr, held_now)
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS:
+                attr = _self_attr(node.func.value)
+                if attr:
+                    record(node, attr, held_now)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held_now)
+
+        for child in method.body:
+            visit(child, set(held))
+
+
+# ---------------------------------------------------------------------------
+# swallow-guard
+# ---------------------------------------------------------------------------
+
+#: calls that count as "the handler tells someone": logging, tracebacks,
+#: the library's console seam
+_DIAGNOSTIC_CALLS = {"print_exc", "print_exception", "format_exc", "emit",
+                     "warning", "warn", "error", "exception", "log",
+                     "debug", "info", "critical", "fail"}
+
+
+class SwallowGuardRule(Rule):
+    id = "swallow-guard"
+    description = ("catch-all except handlers that neither re-raise, use "
+                   "the exception, nor log it")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._catches_all(node.type):
+                continue
+            if self._handled(node):
+                continue
+            what = "bare `except:`" if node.type is None else \
+                f"`except {_dotted(node.type) or 'Exception'}:`"
+            findings.append(self.finding(
+                ctx, node,
+                f"{what} swallows every error silently; catch specific "
+                f"exception types, or log/re-raise what you catch"))
+        return findings
+
+    @staticmethod
+    def _catches_all(type_node: Optional[ast.AST]) -> bool:
+        if type_node is None:
+            return True
+        elts = type_node.elts if isinstance(type_node, ast.Tuple) \
+            else [type_node]
+        return any(_terminal(e) in ("Exception", "BaseException")
+                   for e in elts)
+
+    @staticmethod
+    def _handled(handler: ast.ExceptHandler) -> bool:
+        for node in handler.body:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Raise):
+                    return True
+                if handler.name and isinstance(sub, ast.Name) and \
+                        sub.id == handler.name:
+                    return True  # bound exception is used (stored/wrapped)
+                if isinstance(sub, ast.Call) and \
+                        _terminal(sub.func) in _DIAGNOSTIC_CALLS:
+                    return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# thread-shutdown
+# ---------------------------------------------------------------------------
+
+
+class ThreadShutdownRule(Rule):
+    id = "thread-shutdown"
+    description = ("daemon threads spawned in a scope with no stop event "
+                   "and no join(): dies mid-write at interpreter exit")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+
+        def scope_of(node: ast.AST) -> ast.AST:
+            """Nearest enclosing ClassDef, else the outermost FunctionDef,
+            else the module — the region where a stop/join path for this
+            thread would plausibly live."""
+            cur, outer_fn = node, None
+            while id(cur) in parents:
+                cur = parents[id(cur)]
+                if isinstance(cur, ast.ClassDef):
+                    return cur
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    outer_fn = cur
+            return outer_fn if outer_fn is not None else ctx.tree
+
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and
+                    _terminal(node.func) == "Thread"):
+                continue
+            daemon = any(kw.arg == "daemon" and
+                         isinstance(kw.value, ast.Constant) and
+                         kw.value.value is True for kw in node.keywords)
+            if not daemon:
+                continue
+            scope = scope_of(node)
+            if self._has_shutdown_path(scope):
+                continue
+            findings.append(self.finding(
+                ctx, node,
+                "daemon thread spawned with no stop event or join() in "
+                "scope — it dies mid-operation at interpreter exit; add a "
+                "threading.Event + bounded join() shutdown path"))
+        return findings
+
+    @staticmethod
+    def _has_shutdown_path(scope: ast.AST) -> bool:
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Call):
+                if _terminal(node.func) == "Event":
+                    return True
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "join":
+                    recv = node.func.value
+                    if isinstance(recv, ast.Constant):
+                        continue  # "sep".join(...) — string joining
+                    dotted = _dotted(recv) or ""
+                    if dotted.split(".")[-1] in ("path", "posixpath",
+                                                 "ntpath", "os"):
+                        continue  # os.path.join(...) — path joining
+                    return True  # a thread/process join
+        return False
+
+
+# ---------------------------------------------------------------------------
+# bare-print
+# ---------------------------------------------------------------------------
+
+
+class BarePrintRule(Rule):
+    id = "bare-print"
+    description = ("print() in library code — route output through "
+                   "obs.logging (emit / get_logger)")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        return [
+            self.finding(ctx, node,
+                         "bare print() in library code; use obs.logging's "
+                         "emit() for CLI output or get_logger() for "
+                         "diagnostics")
+            for node in ast.walk(ctx.tree)
+            if isinstance(node, ast.Call) and
+            isinstance(node.func, ast.Name) and node.func.id == "print"
+        ]
+
+
+ALL_RULES: Tuple[Rule, ...] = (
+    JitPurityRule(),
+    LockDisciplineRule(),
+    SwallowGuardRule(),
+    ThreadShutdownRule(),
+    BarePrintRule(),
+)
+
+RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}

@@ -286,7 +286,10 @@ def _prefetched(it: Iterator, depth: int) -> Iterator:
     The consumer may abandon the iterator mid-epoch (the trainer takes
     exactly ``n_windows * w`` batches and drops the rest): generator
     close/GC sets ``stop``, the producer's blocked ``put`` times out and
-    the thread exits instead of pinning the current shard forever."""
+    the thread exits instead of pinning the current shard forever; a
+    bounded ``join`` then confirms the exit (ISSUE 3 thread-shutdown
+    rule), so a run's teardown never leaves producers racing interpreter
+    shutdown with a shard file half-read."""
     q: queue.Queue = queue.Queue(maxsize=max(1, depth))
     _END = object()
     stop = threading.Event()
@@ -329,3 +332,9 @@ def _prefetched(it: Iterator, depth: int) -> Iterator:
             yield item
     finally:
         stop.set()
+        # bounded: the producer notices `stop` within one 0.1 s put
+        # timeout; the slack covers an in-flight shard read.  A producer
+        # still alive after this is surfaced, not silently abandoned.
+        t.join(timeout=2.0)
+        if t.is_alive():  # pragma: no cover - pathological IO stall
+            default_registry().counter("stream.producer_leaks").inc()

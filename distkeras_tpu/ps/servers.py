@@ -83,7 +83,10 @@ class ParameterServer:
                                                 TIME_BUCKETS)
 
     # -- update rule (subclass responsibility) ------------------------------
-    def apply_commit(self, delta: Tree, meta: dict) -> None:
+    def apply_commit(self, delta: Tree, meta: dict) -> None:  # dklint: holds=mutex
+        """Apply one commit to the center.  Contract: ``handle_commit``
+        calls this with ``self.mutex`` held — implementations read and
+        replace shared state without re-locking."""
         raise NotImplementedError
 
     def handle_commit(self, delta: Tree, meta: dict) -> None:
@@ -152,7 +155,7 @@ class DeltaParameterServer(ParameterServer):
     i.e. θ_after − θ_pulled) and the EASGD family (delta = elastic force E).
     Parity: reference ``DeltaParameterServer``."""
 
-    def apply_commit(self, delta, meta):
+    def apply_commit(self, delta, meta):  # dklint: holds=mutex
         self.center = _tree_fused_add(self.center, delta, 1.0)
 
 
@@ -161,7 +164,7 @@ class ADAGParameterServer(ParameterServer):
     normalized by worker count (parity: reference ``ADAGParameterServer``;
     upstream README's recommended algorithm)."""
 
-    def apply_commit(self, delta, meta):
+    def apply_commit(self, delta, meta):  # dklint: holds=mutex
         self.center = _tree_fused_add(self.center, delta,
                                       1.0 / self.num_workers)
 
@@ -191,14 +194,14 @@ class DynSGDParameterServer(ParameterServer):
         #: skips the registry's name-format + lock on every commit
         self._h_by_worker: dict = {}
 
-    def _worker_hist(self, w: int):
+    def _worker_hist(self, w: int):  # dklint: holds=mutex
         h = self._h_by_worker.get(w)
         if h is None:
             h = self._h_by_worker[w] = self.registry.histogram(
                 f"ps.staleness.worker{w}", COUNT_BUCKETS)
         return h
 
-    def apply_commit(self, delta, meta):
+    def apply_commit(self, delta, meta):  # dklint: holds=mutex
         staleness = max(0, self.num_updates - int(meta.get("last_update", 0)))
         self.staleness_seen.append(staleness)
         self._h_staleness.observe(staleness)
@@ -248,8 +251,15 @@ class SocketParameterServer:
         self._running.set()
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name="ps-accept")
+        # _threads is appended by this (caller) thread AND the accept
+        # thread, and iterated by stop(): every touch goes through
+        # _conn_lock (dklint lock-discipline).  Append BEFORE start so
+        # index 0 is always the accept thread — an instant connection
+        # could otherwise slot a handler thread in first and stop()'s
+        # [1:] join would skip it.
+        with self._conn_lock:
+            self._threads.append(t)
         t.start()
-        self._threads.append(t)
         return self
 
     def stop(self) -> None:
@@ -262,12 +272,13 @@ class SocketParameterServer:
         # close live worker connections so handlers blocked in recv unblock
         with self._conn_lock:
             conns = list(self._conns)
+            threads = list(self._threads)
         for c in conns:
             try:
                 c.close()
             except OSError:
                 pass
-        for t in self._threads[1:]:
+        for t in threads[1:]:
             t.join(timeout=5)
 
     def __enter__(self):
@@ -290,7 +301,8 @@ class SocketParameterServer:
             t = threading.Thread(target=self._handle_connection, args=(conn,),
                                  daemon=True, name="ps-conn")
             t.start()
-            self._threads.append(t)
+            with self._conn_lock:
+                self._threads.append(t)
 
     def _handle_connection(self, conn: socket.socket):
         reg = self.ps.registry

@@ -19,6 +19,8 @@ from typing import IO, Optional, Union
 
 import numpy as np
 
+from ..obs.logging import get_logger
+
 #: arrays at or below this many elements serialize as nested lists; larger
 #: ones as a shape/dtype/stats summary (a logged metric should never drag
 #: megabytes of weights into the JSONL stream)
@@ -67,7 +69,14 @@ def json_safe(x):
         return out
     try:  # jax.Array and friends expose __array__
         return json_safe(np.asarray(x))
-    except Exception:
+    except (TypeError, ValueError, RuntimeError) as e:
+        # the swallowed catch-all here turned serialization bugs into
+        # silent "<object repr>" strings in the metrics stream (dklint
+        # swallow-guard); narrow types + a warning keep the fallback
+        # without hiding the cause
+        get_logger("utils.metrics").warning(
+            "json_safe: %s is not array-coercible (%s); logging str()",
+            type(x).__name__, e)
         return str(x)
 
 
@@ -108,14 +117,19 @@ class MetricsLogger:
             line = json.dumps(json_safe(rec), allow_nan=False) + "\n"
         with self._lock:
             self.records.append(rec)
-            if line is not None:
+            # re-check under the lock: a concurrent close() may have
+            # retired the sink after the serialization check above
+            if line is not None and self._fh is not None:
                 self._fh.write(line)
         return rec
 
     def close(self) -> None:
-        if self._own and self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        # under the write lock: a concurrent log() must never observe a
+        # half-closed sink (close raced unsynchronized before — dklint)
+        with self._lock:
+            if self._own and self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
     def __enter__(self):
         return self

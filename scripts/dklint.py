@@ -1,0 +1,19 @@
+"""dklint CLI wrapper — static analysis for the distkeras_tpu stack.
+
+``python scripts/dklint.py [paths...]`` from anywhere; the real
+implementation lives in ``distkeras_tpu.analysis.cli`` (also installed as
+the ``dklint`` console script).  Exit codes: 0 clean, 1 findings,
+2 usage/IO error.
+"""
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, ROOT)
+
+from distkeras_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

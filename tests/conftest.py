@@ -36,3 +36,27 @@ def devices():
 @pytest.fixture()
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(autouse=True)
+def _dklint_racecheck():
+    """Opt-in runtime race detector (ISSUE 3): ``DKLINT_RACECHECK=1
+    pytest tests/`` wraps every ParameterServer's mutex + shared dicts in
+    tracking proxies and fails any test whose threads performed an
+    unguarded concurrent write.  No-op (zero overhead) when the env var
+    is unset."""
+    if not os.environ.get("DKLINT_RACECHECK"):
+        yield
+        return
+    from distkeras_tpu.analysis import racecheck
+    with racecheck.enabled() as violations:
+        try:
+            yield
+        finally:
+            # snapshot before the context exit clears the scoped list
+            found = list(violations)
+    assert not found, (
+        "dklint racecheck: unguarded concurrent write(s) to PS shared "
+        "state:\n" + "\n".join(
+            f"  {v['dict']}[{v['key']!r}] via {v['op']} on thread "
+            f"{v['thread']}\n{v['stack']}" for v in found))

@@ -1,0 +1,585 @@
+"""dklint tests (ISSUE 3): per-rule positive/negative fixtures, the
+suppression layers (inline pragma + baseline round-trip), the runtime
+racecheck proxies, the CLI contract, and — as the tier-1 gate — the
+repo-wide clean run over ``distkeras_tpu/``."""
+
+import json
+import os
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.analysis import (analyze_source, apply_baseline,
+                                    load_baseline, run_paths,
+                                    write_baseline)
+from distkeras_tpu.analysis import racecheck
+from distkeras_tpu.analysis.cli import main as dklint_main
+from distkeras_tpu.analysis.rules import RULES_BY_ID
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint(src, rule=None):
+    """Findings for one dedented source snippet (optionally one rule)."""
+    rules = [RULES_BY_ID[rule]] if rule else None
+    report = analyze_source(textwrap.dedent(src), rules=rules)
+    assert not report.errors, report.errors
+    return report.findings
+
+
+# ---------------------------------------------------------------------------
+# jit-purity
+# ---------------------------------------------------------------------------
+
+def test_jit_purity_flags_impure_decorated_fn():
+    found = lint("""
+        import jax, time
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            t = time.time()
+            r = np.random.rand(3)
+            v = x.item()
+            h = np.asarray(x)
+            s = float(x)
+            return x + t
+        """, rule="jit-purity")
+    msgs = " ".join(f.message for f in found)
+    assert len(found) == 5
+    assert "time.time" in msgs and "np.random.rand" in msgs
+    assert ".item()" in msgs and "np.asarray" in msgs and "float" in msgs
+
+
+def test_jit_purity_partial_decorator_and_call_site():
+    found = lint("""
+        import functools, jax, time
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def run(x):
+            time.sleep(1)
+            return x
+
+        def body(c, x):
+            import time as t
+            time.perf_counter()
+            return c, x
+
+        out = jax.lax.scan(body, 0, xs)
+        """, rule="jit-purity")
+    assert len(found) == 2  # sleep in decorated fn, perf_counter in scan body
+
+
+def test_jit_purity_sync_on_chained_and_subscript_receivers():
+    # the common real shapes: the receiver of .item() is a Call or a
+    # Subscript, not a bare Name — must still be flagged
+    found = lint("""
+        import jax
+
+        @jax.jit
+        def step(state, loss):
+            a = loss.mean().item()
+            b = state["loss"].item()
+            return a + b
+        """, rule="jit-purity")
+    assert len(found) == 2
+    assert all(".item()" in f.message for f in found)
+
+
+def test_jit_purity_negatives():
+    # impure calls OUTSIDE traced functions are fine; jnp/lax inside are
+    # fine; np.random.default_rng is the seeded object API, not flagged
+    found = lint("""
+        import jax, time
+        import jax.numpy as jnp
+        import numpy as np
+
+        def host_setup():
+            t = time.time()
+            rng = np.random.default_rng(0)
+            return np.asarray([t])
+
+        @jax.jit
+        def step(x):
+            rng = np.random.default_rng(0)  # seeded, object-based
+            return jnp.sum(x) + jnp.asarray(1.0)
+        """, rule="jit-purity")
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+def test_lock_discipline_flags_mixed_writes():
+    found = lint("""
+        import threading
+
+        class Store:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.items = {}
+
+            def put(self, k, v):
+                with self.lock:
+                    self.items[k] = v
+
+            def unsafe_clear(self):
+                self.items = {}
+        """, rule="lock-discipline")
+    assert len(found) == 1
+    assert "unsafe_clear" in found[0].message
+    assert "self.lock" in found[0].message
+
+
+def test_lock_discipline_negatives_and_init_exemption():
+    found = lint("""
+        import threading
+
+        class Store:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.items = {}   # construction happens-before threads
+
+            def put(self, k, v):
+                with self.lock:
+                    self.items[k] = v
+
+            def snapshot(self):
+                with self.lock:
+                    return dict(self.items)
+        """, rule="lock-discipline")
+    assert found == []
+
+
+def test_lock_discipline_holds_pragma_declares_contract():
+    found = lint("""
+        import threading
+
+        class PS:
+            def __init__(self):
+                self.mutex = threading.Lock()
+                self.center = 0
+
+            def handle(self, d):
+                with self.mutex:
+                    self.apply(d)
+
+            def apply(self, d):  # dklint: holds=mutex
+                self.center = self.center + d
+        """, rule="lock-discipline")
+    assert found == []
+
+
+def test_lock_discipline_sees_subclass_writes():
+    # base guards the attribute; the subclass writing it bare is exactly
+    # the inheritance hole the rule must close
+    found = lint("""
+        import threading
+
+        class Base:
+            def __init__(self):
+                self.mutex = threading.Lock()
+                self.center = 0
+
+            def handle(self, d):
+                with self.mutex:
+                    self.center += d
+
+        class Sub(Base):
+            def sneak(self, d):
+                self.center = d
+        """, rule="lock-discipline")
+    assert len(found) == 1 and "Sub.sneak" in found[0].message
+
+
+def test_lock_discipline_mutator_calls_count_as_writes():
+    found = lint("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.pending = []
+
+            def add(self, x):
+                with self.lock:
+                    self.pending.append(x)
+
+            def requeue(self, x):
+                self.pending.append(x)
+        """, rule="lock-discipline")
+    assert len(found) == 1 and "requeue" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# swallow-guard
+# ---------------------------------------------------------------------------
+
+def test_swallow_guard_flags_silent_catchalls():
+    found = lint("""
+        def a():
+            try:
+                risky()
+            except:
+                pass
+
+        def b():
+            try:
+                risky()
+            except Exception:
+                return None
+        """, rule="swallow-guard")
+    assert len(found) == 2
+
+
+def test_swallow_guard_negatives():
+    found = lint("""
+        import traceback
+
+        def ok():
+            try:
+                risky()
+            except OSError:          # specific type: caller's judgment
+                pass
+            try:
+                risky()
+            except Exception:
+                raise                # re-raised
+            try:
+                risky()
+            except Exception as e:
+                self.error = e       # stored for later surfacing
+            try:
+                risky()
+            except Exception:
+                traceback.print_exc()  # diagnosed
+            try:
+                risky()
+            except Exception:
+                log.warning("boom")    # logged
+        """, rule="swallow-guard")
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# thread-shutdown
+# ---------------------------------------------------------------------------
+
+def test_thread_shutdown_flags_unjoinable_daemon():
+    found = lint("""
+        import threading
+
+        def fire_and_forget(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+        """, rule="thread-shutdown")
+    assert len(found) == 1
+
+
+def test_thread_shutdown_not_fooled_by_path_or_str_join():
+    # os.path.join / "sep".join in scope must NOT count as a thread join
+    found = lint("""
+        import os, threading
+
+        def fire_and_forget(fn, parts):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            return os.path.join("/tmp", "x"), ",".join(parts)
+        """, rule="thread-shutdown")
+    assert len(found) == 1
+
+
+def test_thread_shutdown_accepts_stop_event_or_join():
+    found = lint("""
+        import threading
+
+        def with_event(fn):
+            stop = threading.Event()
+            t = threading.Thread(target=fn, args=(stop,), daemon=True)
+            t.start()
+            return stop
+
+        class Server:
+            def start(self):
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def stop(self):
+                self._t.join(timeout=5)
+        """, rule="thread-shutdown")
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# bare-print
+# ---------------------------------------------------------------------------
+
+def test_bare_print_rule():
+    found = lint("""
+        from distkeras_tpu.obs import emit
+
+        def noisy():
+            print("hello")
+
+        def fine():
+            emit("hello")
+        """, rule="bare-print")
+    assert len(found) == 1 and found[0].line == 5
+
+
+# ---------------------------------------------------------------------------
+# suppression: inline pragma + baseline round-trip
+# ---------------------------------------------------------------------------
+
+def test_inline_disable_pragma():
+    src = """
+        def noisy():
+            print("a")
+            print("b")  # dklint: disable=bare-print
+            print("c")  # dklint: disable
+        """
+    report = analyze_source(textwrap.dedent(src),
+                            rules=[RULES_BY_ID["bare-print"]])
+    assert len(report.findings) == 1          # only the unsuppressed one
+    assert len(report.inline_suppressed) == 2
+
+
+def test_baseline_round_trip(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    mod = pkg / "mod.py"
+    mod.write_text("def f():\n    print('legacy')\n")
+
+    report = run_paths([str(pkg)])
+    assert len(report.findings) == 1
+    baseline = tmp_path / "baseline.json"
+    write_baseline(str(baseline), report.findings)
+
+    # same findings, baseline applied -> clean
+    fresh = apply_baseline(run_paths([str(pkg)]),
+                           load_baseline(str(baseline)))
+    assert fresh.findings == []
+    assert len(fresh.baseline_suppressed) == 1
+
+    # a NEW violation (after unrelated line drift above the old one)
+    # still fails: fingerprints are content-addressed, not line-addressed
+    mod.write_text("import os\n\n\ndef f():\n    print('legacy')\n"
+                   "    print('new')\n")
+    drifted = apply_baseline(run_paths([str(pkg)]),
+                             load_baseline(str(baseline)))
+    assert len(drifted.findings) == 1
+    assert drifted.findings[0].snippet == "print('new')"
+    assert len(drifted.baseline_suppressed) == 1
+
+
+def test_fingerprints_stable_across_invocation_shapes(tmp_path):
+    """A baselined finding must keep matching whether dklint is pointed
+    at the repo root, the package dir, or the file itself — fingerprints
+    anchor at the marker directory, not the scan argument."""
+    root = tmp_path / "repo"
+    (root / "pkg").mkdir(parents=True)
+    (root / "pyproject.toml").write_text("[project]\nname='x'\n")
+    (root / "pkg" / "mod.py").write_text("def f():\n    print('x')\n")
+
+    shapes = [str(root), str(root / "pkg"), str(root / "pkg" / "mod.py")]
+    fps = [run_paths([s]).findings[0].fingerprint for s in shapes]
+    assert fps[0] == fps[1] == fps[2]
+    assert run_paths([shapes[0]]).findings[0].rel == "pkg/mod.py"
+
+
+def test_cli_discovers_baseline_from_anywhere(tmp_path, capsys, monkeypatch):
+    root = tmp_path / "repo"
+    (root / "pkg").mkdir(parents=True)
+    (root / "pkg" / "mod.py").write_text("def f():\n    print('x')\n")
+    monkeypatch.chdir(root)
+    assert dklint_main(["pkg", "--write-baseline"]) == 0
+    assert (root / "dklint_baseline.json").exists()
+    # from an unrelated cwd, the absolute path still finds the baseline
+    monkeypatch.chdir(tmp_path)
+    assert dklint_main([str(root / "pkg")]) == 0
+    assert dklint_main([str(root / "pkg" / "mod.py")]) == 0
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# racecheck (runtime)
+# ---------------------------------------------------------------------------
+
+def _tree(v):
+    return {"params": [{"w": np.asarray(v, dtype=np.float32)}], "state": [{}]}
+
+
+def test_racecheck_catches_seeded_unguarded_write():
+    from distkeras_tpu.ps.servers import DeltaParameterServer
+    with racecheck.enabled() as violations:
+        ps = DeltaParameterServer(_tree([0.0]), num_workers=2)
+        assert isinstance(ps.mutex, racecheck.TrackedLock)
+
+        # a second thread committing properly (through handle_commit,
+        # which takes the mutex) is legal...
+        t = threading.Thread(
+            target=lambda: ps.handle_commit(_tree([1.0]), {"worker_id": 1}))
+        t.start()
+        t.join()
+        assert violations == []
+
+        # ...but the seeded bug — writing the shared dict with no lock
+        # from a second thread — must be caught
+        def buggy():
+            ps.commits_by_worker[9] = 99   # no mutex: the race
+
+        t2 = threading.Thread(target=buggy)
+        t2.start()
+        t2.join()
+        assert len(violations) == 1
+        v = violations[0]
+        assert v["dict"].endswith("commits_by_worker") and v["key"] == 9
+    assert racecheck.violations() == []  # scoped: cleared at block exit
+
+
+def test_racecheck_clean_on_threaded_ps_traffic():
+    """The existing threaded PS protocol (socket front-end, concurrent
+    worker commits) runs violation-free under the proxies — the
+    acceptance bar for turning DKLINT_RACECHECK on over the suite."""
+    from distkeras_tpu.ps.client import PSClient
+    from distkeras_tpu.ps.servers import (DynSGDParameterServer,
+                                          SocketParameterServer)
+    with racecheck.enabled() as violations:
+        ps = DynSGDParameterServer(_tree([0.0]), num_workers=3)
+        with SocketParameterServer(ps) as server:
+            def worker(k):
+                client = PSClient("127.0.0.1", server.port, k)
+                try:
+                    for i in range(5):
+                        _, seen = client.pull()
+                        client.commit(_tree([0.5]), last_update=seen)
+                finally:
+                    client.close()
+
+            threads = [threading.Thread(target=worker, args=(k,))
+                       for k in range(3)]
+            [t.start() for t in threads]
+            [t.join() for t in threads]
+            stats = ps.stats()
+        assert stats["num_updates"] == 15
+        assert sorted(stats["commits_by_worker"]) == [0, 1, 2]
+        assert violations == []
+
+
+def test_racecheck_wraps_subclass_dicts():
+    # DynSGD creates _h_by_worker AFTER super().__init__ — the wrap must
+    # still land (hierarchy-wide install, not base-class-only)
+    from distkeras_tpu.ps.servers import DynSGDParameterServer
+    with racecheck.enabled():
+        ps = DynSGDParameterServer(_tree([0.0]), num_workers=2)
+        assert isinstance(ps.commits_by_worker, racecheck.GuardedDict)
+        assert isinstance(ps._h_by_worker, racecheck.GuardedDict)
+
+
+def test_racecheck_survives_restore_rebind(tmp_path):
+    # restore() rebinds commits_by_worker to a plain dict; the install
+    # hook must re-wrap it or detection silently dies post-restore
+    from distkeras_tpu.ps.servers import DeltaParameterServer
+    from distkeras_tpu.utils.checkpoint import CheckpointManager
+    ckpt = CheckpointManager(str(tmp_path), keep=2)
+    with racecheck.enabled() as violations:
+        ps = DeltaParameterServer(_tree([0.0]), num_workers=2)
+        ps.handle_commit(_tree([1.0]), {"worker_id": 0})
+        ckpt.save(1, ps.center, {"num_updates": 1,
+                                 "commits_by_worker": {0: 1}})
+        assert ps.restore(ckpt)
+        assert isinstance(ps.commits_by_worker, racecheck.GuardedDict)
+        t = threading.Thread(
+            target=lambda: ps.commits_by_worker.__setitem__(7, 1))
+        t.start()
+        t.join()
+        assert len(violations) == 1
+
+
+@pytest.mark.skipif(bool(os.environ.get(racecheck.ENV_VAR)),
+                    reason="conftest fixture keeps racecheck installed "
+                           "for the whole test under DKLINT_RACECHECK")
+def test_racecheck_uninstall_restores_plain_ps():
+    from distkeras_tpu.ps.servers import DeltaParameterServer
+    with racecheck.enabled():
+        pass
+    ps = DeltaParameterServer(_tree([0.0]))
+    assert not isinstance(ps.mutex, racecheck.TrackedLock)
+    assert type(ps.commits_by_worker) is dict
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_json_format_and_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f():\n    print('x')\n")
+
+    rc = dklint_main([str(bad), "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [f["rule"] for f in out["findings"]] == ["bare-print"]
+
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    assert dklint_main([str(good)]) == 0
+    capsys.readouterr()
+
+    assert dklint_main([str(tmp_path / "missing.py")]) == 2
+    assert dklint_main([str(good), "--rules", "no-such-rule"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_write_baseline_then_clean(tmp_path, capsys, monkeypatch):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f():\n    print('x')\n")
+    baseline = tmp_path / "bl.json"
+    assert dklint_main([str(bad), "--baseline", str(baseline),
+                        "--write-baseline"]) == 0
+    assert dklint_main([str(bad), "--baseline", str(baseline)]) == 0
+    # default discovery: a dklint_baseline.json in cwd is picked up
+    monkeypatch.chdir(tmp_path)
+    os.rename(baseline, tmp_path / "dklint_baseline.json")
+    assert dklint_main([str(bad)]) == 0
+    capsys.readouterr()
+
+
+def test_cli_write_baseline_rejects_rule_subset(tmp_path, capsys):
+    # a subset run must not overwrite the baseline (it would drop every
+    # other rule's accepted debt)
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f():\n    print('x')\n")
+    assert dklint_main([str(bad), "--rules", "bare-print",
+                        "--write-baseline"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_list_rules(capsys):
+    assert dklint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("jit-purity", "lock-discipline", "swallow-guard",
+                "thread-shutdown", "bare-print"):
+        assert rid in out
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the repo itself is clean
+# ---------------------------------------------------------------------------
+
+def test_repo_is_dklint_clean():
+    """Full rule set over ``distkeras_tpu/`` with the committed baseline:
+    zero unsuppressed findings.  Any new jit impurity, unguarded shared
+    write, swallowed exception, unjoinable daemon thread or bare print
+    fails tier-1 — the generalization of PR 2's print gate."""
+    pkg = os.path.join(_ROOT, "distkeras_tpu")
+    report = run_paths([pkg])
+    assert not report.errors, report.errors
+    baseline_path = os.path.join(_ROOT, "dklint_baseline.json")
+    apply_baseline(report, load_baseline(baseline_path))
+    pretty = "\n".join(f"{f.location()}: [{f.rule}] {f.message}"
+                       for f in report.findings)
+    assert not report.findings, f"dklint findings in library code:\n{pretty}"

@@ -7,7 +7,6 @@ staleness memory), the MetricsLogger JSON hardening, the no-bare-print
 gate, and ``scripts/obsview.py`` end to end — synthetic JSONL plus real
 ``SingleTrainer`` / async-PS runs (the acceptance criterion)."""
 
-import ast
 import importlib.util
 import io
 import json
@@ -225,26 +224,10 @@ def test_metrics_logger_concurrent_lines_stay_whole():
 
 
 # -- no bare prints in library code (satellite) ------------------------------
-
-def test_no_bare_prints_in_library():
-    """Library output goes through obs.logging (emit/get_logger); a bare
-    ``print(`` anywhere in ``distkeras_tpu/`` is a regression."""
-    pkg = os.path.join(_ROOT, "distkeras_tpu")
-    offenders = []
-    for dirpath, _dirs, files in os.walk(pkg):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path) as f:
-                tree = ast.parse(f.read(), filename=path)
-            for node in ast.walk(tree):
-                if isinstance(node, ast.Call) and \
-                        isinstance(node.func, ast.Name) and \
-                        node.func.id == "print":
-                    offenders.append(
-                        f"{os.path.relpath(path, _ROOT)}:{node.lineno}")
-    assert not offenders, f"bare print() in library code: {offenders}"
+# PR 2's one-off AST gate lived here; ISSUE 3 migrated it into the dklint
+# ``bare-print`` rule, enforced repo-wide by
+# tests/test_analysis.py::test_repo_is_dklint_clean — one analysis
+# framework, not two.
 
 
 # -- instrumented PS stack ---------------------------------------------------
