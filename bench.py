@@ -23,8 +23,17 @@ The anchor value is the round-1 first-measured throughput on this same
 workload+metric (end-to-end samples/sec with a hard final sync); the
 harness version that produced each number is recorded alongside so
 methodology changes are visible (HARNESS below).
+
+``python bench.py --ps [--codec C] [--windows N] [--mb M]`` runs the
+**PS-comms microbenchmark** instead (ISSUE 4): a localhost
+SocketParameterServer + one client doing pull/commit windows over an
+M-MB synthetic center, printing one JSON line with the commit RTT and
+wire bytes per communication window, and persisting the client+server
+obs registry snapshots beside the BENCH_r*.json files (the ROADMAP
+telemetry item) so runs can diff distributions, not just wall numbers.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -101,5 +110,97 @@ def main():
     }))
 
 
-if __name__ == "__main__":
+def bench_ps(codec: str = "none", windows: int = 50, mb: float = 4.0,
+             out_dir: str = ROOT, wire_version=None) -> dict:
+    """PS-comms microbenchmark (ISSUE 4 acceptance): N pull+commit windows
+    against a localhost PS over an ``mb``-megabyte synthetic center.
+
+    Returns (and ``main`` prints) one JSON row: median/p99 commit RTT,
+    wire bytes per window, pull/commit counts, compression ratio.  The
+    client and server registry snapshots are written to
+    ``<out_dir>/BENCH_PS_OBS.json`` — the per-run snapshot persistence the
+    ROADMAP telemetry item asks for, diffable across PRs.
+    """
+    from distkeras_tpu.obs import Registry
+    from distkeras_tpu.ps import PSClient, SocketParameterServer
+    from distkeras_tpu.ps.servers import DeltaParameterServer
+
+    rng = np.random.default_rng(0)
+    # 8 equal fp32 leaves totalling ~mb MB — tensor-shaped like a model,
+    # not one giant blob, so framing/segment overhead is realistic
+    n = max(1, int(mb * (1 << 20) / 4 / 8))
+    center = {"params": [{"w": rng.normal(size=n).astype(np.float32)}
+                         for _ in range(8)], "state": [{} for _ in range(8)]}
+    delta = {"params": [{"w": (0.01 * rng.normal(size=n)).astype(np.float32)}
+                        for _ in range(8)], "state": [{} for _ in range(8)]}
+
+    ps = DeltaParameterServer(center, num_workers=1)
+    creg = Registry()  # client-side instruments, isolated for the report
+    rtts = []
+    with SocketParameterServer(ps) as server:
+        with PSClient("127.0.0.1", server.port, 0, registry=creg,
+                      codec=codec, wire_version=wire_version) as client:
+            negotiated = client.wire_version  # what actually ran the wire
+            client.pull()  # connection + first center transfer warm
+            b0 = creg.counter("net.bytes_sent").value \
+                + creg.counter("net.bytes_recv").value
+            for _ in range(int(windows)):
+                client.pull()
+                t0 = time.perf_counter()
+                client.commit(delta)
+                rtts.append(time.perf_counter() - t0)
+            wire_bytes = creg.counter("net.bytes_sent").value \
+                + creg.counter("net.bytes_recv").value - b0
+    raw = creg.counter("ps.codec.bytes_raw").value
+    enc = creg.counter("ps.codec.bytes_encoded").value
+    row = {
+        "metric": "ps commit RTT (localhost, "
+                  f"{mb:g} MB center, codec={codec})",
+        "mode": "bench_ps", "codec": codec, "windows": int(windows),
+        "center_mb": round(mb, 3),
+        "commit_rtt_ms_p50": round(float(np.median(rtts)) * 1e3, 3),
+        "commit_rtt_ms_p99": round(float(np.quantile(rtts, 0.99)) * 1e3, 3),
+        "wire_bytes_per_window": round(wire_bytes / max(1, int(windows))),
+        #: as NEGOTIATED on the live connection (env pins like DKTPU_WIRE=1
+        #: and server refusals included) — benchmark provenance must name
+        #: the frame format that actually carried the traffic
+        "wire_version": negotiated,
+        "compression_ratio": round(raw / enc, 3) if enc else 1.0,
+        "bytes_saved": creg.counter("ps.codec.bytes_saved").value,
+    }
+    snap_path = os.path.join(out_dir, "BENCH_PS_OBS.json")
+    with open(snap_path, "w") as f:
+        json.dump({"config": {k: row[k] for k in
+                              ("codec", "windows", "center_mb",
+                               "wire_version")},
+                   "client": creg.snapshot(),
+                   "server": ps.registry.snapshot()}, f, indent=1)
+    row["snapshot"] = os.path.relpath(snap_path, ROOT)
+    return row
+
+
+def _cli(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ps", action="store_true",
+                    help="run the PS-comms microbenchmark instead of the "
+                         "trainer headline")
+    ap.add_argument("--codec", default="none",
+                    help="bench_ps commit codec: none|int8|bf16|topk<frac>")
+    ap.add_argument("--windows", type=int, default=50,
+                    help="bench_ps pull+commit windows")
+    ap.add_argument("--mb", type=float, default=4.0,
+                    help="bench_ps synthetic center size in MB")
+    ap.add_argument("--wire", type=int, default=None, choices=(1, 2),
+                    help="bench_ps: pin the frame format (default: "
+                         "negotiate v2)")
+    args = ap.parse_args(argv)
+    if args.ps:
+        print(json.dumps(bench_ps(codec=args.codec, windows=args.windows,
+                                  mb=args.mb, wire_version=args.wire)))
+        return 0
     main()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_cli())

@@ -194,17 +194,27 @@ def run(cfg: RunConfig, repeat: int = 1) -> dict:
     if isinstance(model, list):  # EnsembleTrainer
         model = model[0]
     # repeats after the first are fully warm: median over those when
-    # available, else the single measurement
+    # available, else the single measurement.  Spread is over the WARM
+    # runs only (the cold call's compile time is not "spread"), and only
+    # reported when there are >= 2 of them — with repeat=2 there is ONE
+    # warm run: label it as such instead of a misleading "median of 1"
+    # and leave the spread empty (ISSUE 4 satellite).
     vals = [r for r, _ in (rates[1:] if len(rates) > 1 else rates)]
-    note = rates[-1][1] if len(rates) == 1 else \
-        f"median of {len(vals)} warm runs"
+    if len(rates) == 1:
+        note = rates[-1][1]
+    elif len(vals) == 1:
+        note = "single warm run, cold excluded"
+    else:
+        note = f"median of {len(vals)} warm runs"
+    spread = (float(np.min(vals)), float(np.max(vals))) \
+        if len(vals) > 1 else None
     acc = None
     if test is not None:
         pred = dk.ModelPredictor(model, "features").predict(test)
         acc = dk.AccuracyEvaluator("prediction", "label").evaluate(pred)
     return {"name": cfg.name,
             "samples_per_sec": float(np.median(vals)),
-            "spread": (float(np.min(vals)), float(np.max(vals))),
+            "spread": spread,  # (min, max) over warm runs; None if < 2
             "rates": [float(r) for r, _ in rates],  # per-call, run order
             "note": note, "accuracy": acc,
             "wall_seconds": float(np.sum(walls))}
@@ -256,8 +266,11 @@ def main(argv=None) -> int:
     for cfg in cfgs:
         row = run(cfg, repeat=args.repeat)
         acc = f"{row['accuracy']:.3f}" if row["accuracy"] is not None else "—"
-        lo, hi = row["spread"]
-        spread = "—" if args.repeat <= 1 else f"{lo:,.0f}–{hi:,.0f}"
+        if row["spread"] is None:  # < 2 warm runs: no meaningful spread
+            spread = "—"
+        else:
+            lo, hi = row["spread"]
+            spread = f"{lo:,.0f}–{hi:,.0f}"
         emit(f"| {row['name']} | {row['samples_per_sec']:,.0f} "
              f"({row['note']}) | {spread} | {acc} "
              f"| {row['wall_seconds']:.1f}s |")

@@ -22,7 +22,15 @@ class Evaluator:
     probability vectors, argmaxed on the last axis).  Pass an explicit
     kind when auto-inference is ambiguous — e.g. integer (B, T) per-token
     targets over a binary vocabulary, which value-based inference could
-    misread as one-hot rows (ADVICE r3)."""
+    misread as one-hot rows (ADVICE r3).  Integer one-hot labels with
+    3+ columns and 8+ rows are read as one-hot silently (the
+    per-token-ids reading would need every row of the eval set to hold
+    exactly one 1-token — not a plausible coincidence at that size);
+    only genuinely ambiguous shapes warn: 2-column arrays (``[0, 1]``
+    rows are equally consistent with 2-class one-hot and 2-token binary
+    ids) and tiny eval sets.  Pass ``label_kind='onehot'`` (or
+    ``'ids'``) to state which reading applies and silence the
+    warning."""
 
     def __init__(self, prediction_col: str = "prediction",
                  label_col: str = "label", prediction_kind: str = "auto",
@@ -60,17 +68,25 @@ def _to_class_index(a: np.ndarray, threshold: float = 0.5,
             a = a[..., 0]
         if a.ndim == 2 and a.shape[-1] > 1 and a.min() >= 0 \
                 and a.max() <= 1 and np.all(a.sum(axis=-1) == 1):
-            # (B, T) per-token ids over a binary vocabulary hit this same
-            # shape/value signature; the caller must disambiguate (ADVICE
-            # r4: warn instead of silently argmaxing)
-            import warnings
-            warnings.warn(
-                "auto kind read a 2-D integer array whose rows sum to 1 "
-                "as one-hot rows and argmaxed it; pass prediction_kind/"
-                "label_kind='ids' if the column holds (B, T) per-token "
-                "class ids over a binary vocabulary, or 'onehot' to "
-                "confirm one-hot rows and silence this warning",
-                stacklevel=3)
+            # every row holds exactly one 1: one-hot rows.  The competing
+            # reading — (B, T) per-token ids over a binary vocabulary —
+            # would require every row of the eval set to coincidentally
+            # hold exactly one 1-token: at C >= 3 columns and B >= 8 rows
+            # that chance is < (3/8)^8 ≈ 4e-4, so legitimate one-hot
+            # evals read silently (ISSUE 4 satellite; ADVICE r4 warned on
+            # all of them).  Genuinely ambiguous shapes still warn:
+            # 2-column rows ([0, 1] reads both ways at ANY size) and
+            # too-few-row arrays (the signature is weak evidence).
+            if a.shape[-1] == 2 or a.shape[0] < 8:
+                import warnings
+                warnings.warn(
+                    f"auto kind read a {a.shape} integer array whose rows "
+                    "sum to 1 as one-hot rows and argmaxed it, but this "
+                    "shape is also consistent with (B, T) per-token class "
+                    "ids over a binary vocabulary; pass prediction_kind/"
+                    "label_kind='ids' if the column holds per-token ids, "
+                    "or 'onehot' to confirm one-hot rows and silence this "
+                    "warning", stacklevel=3)
             return np.argmax(a, axis=-1)  # integer one-hot rows
         return a.astype(np.int64)         # class ids, (B,) or (B, T)
     if a.ndim >= 2 and a.shape[-1] > 1:

@@ -126,6 +126,27 @@ class ZigzagStripe(Layer):
         return {"p_size": self.p_size, "inverse": self.inverse}
 
 
+def _clone_for_wrap(layer, mha_cls):
+    """Shallow-copy ``layer`` iff it is (or contains) a ``mha_cls``
+    attention layer, rebuilding the container spine (``layers`` /
+    ``inner`` / ``shortcut``) down to fresh attention objects; everything
+    attention-free is shared.  Hyperparameters copy over, params stay
+    positional — the clones run the original variables unchanged."""
+    import copy
+    if isinstance(layer, mha_cls):
+        return copy.copy(layer)
+    if not any(isinstance(s, mha_cls) for s in layer.iter_layers()):
+        return layer
+    clone = copy.copy(layer)
+    if getattr(clone, "layers", None):
+        clone.layers = [_clone_for_wrap(l, mha_cls) for l in clone.layers]
+    for attr in ("inner", "shortcut"):
+        sub = getattr(clone, attr, None)
+        if isinstance(sub, Layer):
+            setattr(clone, attr, _clone_for_wrap(sub, mha_cls))
+    return clone
+
+
 class _ZigzagWrappedModel(Model):
     """A zigzag-wrapped model is a RUNTIME artifact: its mesh attachment
     and ``ring_pre_shuffled`` flags are trace-time layer attributes that
@@ -163,11 +184,13 @@ def zigzag_wrap(model: Model, mesh, *, axis: str = "sp",
     scratch or adapt existing variables by inserting empty ``{}``
     param/state entries at those positions.
 
-    NOTE: the wrapped model SHARES the original's layer objects (the
-    mesh attachment mutates their runtime placement attributes, like
-    ``layer.mesh = mesh`` does) — don't run the original model while
-    the wrap is active; detach via ``layer.mesh = None;
-    layer.ring_pre_shuffled = False`` to restore it.
+    The attention layers in the wrapped stack are SHALLOW COPIES of the
+    original's (ADVICE r5): the mesh attachment and ``ring_pre_shuffled``
+    land on the copies only, so the ORIGINAL model stays runnable (dense
+    attention, natural token order) while the wrap is active.  Params are
+    positional — both stacks accept the same variables (modulo the two
+    empty boundary inserts).  Non-attention layers are shared, as are
+    container layers without nested attention.
     """
     from ..ops.attention import MultiHeadAttention, PositionalEmbedding
     if not isinstance(model.layer, Sequential):
@@ -223,7 +246,11 @@ def zigzag_wrap(model: Model, mesh, *, axis: str = "sp",
                          "zigzag_wrap is for the ring impls (unset "
                          "layer.ring_impl or pass impl='flash'/"
                          "'blockwise')")
-    for l in mhas:
+    # clone the stack so the runtime placement below mutates COPIES; the
+    # original model keeps running dense attention (ADVICE r5)
+    layers = [_clone_for_wrap(l, MultiHeadAttention) for l in layers]
+    for l in (s for lyr in layers for s in lyr.iter_layers()
+              if isinstance(s, MultiHeadAttention)):
         l.mesh = mesh
         l.ring_axis = axis
         if batch_axis is not None:  # preserve an existing dp attachment

@@ -20,6 +20,37 @@ import jax
 import jax.numpy as jnp
 
 from ..models.layers import Layer, glorot_uniform, register, uniform_scale
+from ..obs import get_logger
+
+#: minimum sequence length for a causal mesh-attached layer to AUTO-pick
+#: the zigzag ring layout (ADVICE r5): zigzag halves the causal ring's
+#: executed FLOPs, but without :func:`models.optimize.zigzag_wrap` every
+#: attention call pays a shuffle + unshuffle of its activations (two
+#: global token-axis gathers) — a net loss at small T, where attention is
+#: not the dominant cost.  Pin ``layer.ring_layout`` to override either
+#: way; ``zigzag_wrap`` amortizes the stripe to once per batch and forces
+#: zigzag regardless of this threshold.
+ZIGZAG_AUTO_MIN_T = 256
+
+#: layout decisions already logged (once per distinct choice, not per
+#: trace/call — the auto-switch must not be silent, ADVICE r5)
+_LAYOUT_LOGGED: set = set()
+
+
+def _log_layout_choice(layout: str, t: int, sp: int) -> None:
+    key = (layout, t, sp)
+    if key in _LAYOUT_LOGGED:
+        return
+    _LAYOUT_LOGGED.add(key)
+    why = (f"T={t} >= ZIGZAG_AUTO_MIN_T={ZIGZAG_AUTO_MIN_T}"
+           if layout == "zigzag" else
+           f"T={t} below ZIGZAG_AUTO_MIN_T={ZIGZAG_AUTO_MIN_T} or "
+           f"not divisible by 2*|sp|={2 * sp}")
+    get_logger("ops.attention").info(
+        "causal ring auto-selected %r layout (%s); zigzag pays a per-call "
+        "shuffle/unshuffle unless models.optimize.zigzag_wrap amortizes "
+        "the stripe to once per batch; pin layer.ring_layout to override",
+        layout, why)
 
 
 def dot_product_attention(q, k, v, *, causal: bool = False):
@@ -244,9 +275,13 @@ class MultiHeadAttention(Layer):
             elif layout is None and ring_impl != "ulysses":
                 # causal rings default to the load-balanced zigzag
                 # stripe when the length allows (exact; ≈half the FLOPs)
+                # AND the sequence is long enough for the saved FLOPs to
+                # beat the per-call stripe gathers (ADVICE r5)
                 sp = self.mesh.shape[self.ring_axis]
                 layout = ("zigzag" if self.causal and t % (2 * sp) == 0
-                          else "contiguous")
+                          and t >= ZIGZAG_AUTO_MIN_T else "contiguous")
+                if self.causal:
+                    _log_layout_choice(layout, t, sp)
             o = ring_attention_sharded(self.mesh, q, k, v,
                                        axis=self.ring_axis,
                                        batch_axis=self.batch_axis,

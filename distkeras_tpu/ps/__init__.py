@@ -13,7 +13,16 @@ workers running jit-compiled window scans on their device between pulls and
 commits.
 """
 
-from .networking import connect, determine_host_address, recv_msg, send_msg  # noqa: F401
+from .networking import (  # noqa: F401
+    WIRE_VERSION,
+    connect,
+    determine_host_address,
+    pack_msg,
+    recv_msg,
+    send_msg,
+    send_packed,
+)
+from .codecs import Codec, decode_tree, get_codec  # noqa: F401
 from .servers import (  # noqa: F401
     ADAGParameterServer,
     DeltaParameterServer,

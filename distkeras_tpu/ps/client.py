@@ -2,7 +2,21 @@
 
 Parity with the reference's worker-side socket usage (reference
 ``distkeras/workers.py:NetworkWorker.pull``/``commit``): full center down,
-delta up, at communication-window boundaries.
+delta up, at communication-window boundaries — with the ISSUE 4 fast path
+layered on:
+
+* **wire negotiation** — a ``hello`` handshake on connect picks the
+  newest frame format both ends speak (v2 zero-copy scatter-gather when
+  the server is current, v1 msgpack blobs against old servers, which
+  answer ``hello`` with an unknown-action error we treat as "v1 only");
+* **pull caching** — ``pull`` reports the update counter of the center
+  this client already holds; the server answers ``unchanged`` without
+  re-shipping the center when no commits landed, and the cached copy is
+  returned (the caller must treat pulled trees as read-only, which the
+  workers' replace-style updates already do);
+* **delta codecs** — an optional ``ps.codecs`` codec compresses commit
+  payloads (int8/bf16/top-k with worker-side error feedback); encode
+  latency and bytes saved land in this client's registry.
 
 Instrumented (ISSUE 2): every RPC observes its round-trip latency into a
 ``ps.client.rtt_seconds`` histogram and reconnect events count under
@@ -17,16 +31,19 @@ owns that failure, as in the reference's Spark task retry).
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Optional
 
 from ..obs import TIME_BUCKETS, Registry, default_registry
-from .networking import connect, recv_msg, send_msg
+from . import codecs
+from .networking import WIRE_VERSION, connect, recv_msg, send_msg
 
 
 class PSClient:
     def __init__(self, host: str, port: int, worker_id: int = 0,
-                 registry: Optional[Registry] = None):
+                 registry: Optional[Registry] = None,
+                 codec=None, wire_version: Optional[int] = None):
         self.worker_id = int(worker_id)
         self.host = host
         self.port = port
@@ -34,17 +51,57 @@ class PSClient:
             else default_registry()
         self._h_rtt = self.registry.histogram("ps.client.rtt_seconds",
                                               TIME_BUCKETS)
+        self._h_encode = self.registry.histogram("ps.codec.encode_seconds",
+                                                 TIME_BUCKETS)
         self._c_reconnects = self.registry.counter("ps.client.reconnects")
+        self._c_unchanged = self.registry.counter(
+            "ps.client.pulls_unchanged")
+        #: delta codec (``ps.codecs``) — owned here because its
+        #: error-feedback residual is per-worker state
+        self.codec = codecs.get_codec(codec)
+        #: ``None`` negotiates (the default); ``1`` pins the legacy wire —
+        #: also reachable via ``DKTPU_WIRE=1`` for whole-process opt-out
+        if wire_version is None and os.environ.get("DKTPU_WIRE") == "1":
+            wire_version = 1
+        self._want_version = wire_version
+        self.wire_version = 1
+        #: client-side center cache: (center_tree, server_update_counter)
+        self._last_pull: Optional[tuple] = None
         self.sock = connect(host, port)
+        self._handshake()
+
+    def _handshake(self) -> None:
+        """Negotiate the wire format for this connection.  The hello is
+        always v1-framed (any server parses it); current servers reply
+        with the agreed version, old ones with an unknown-action error —
+        that failure IS the negotiation result: v1."""
+        self.wire_version = 1
+        want = self._want_version if self._want_version is not None \
+            else WIRE_VERSION
+        if want < 2:
+            return
+        send_msg(self.sock, {"action": "hello", "worker_id": self.worker_id,
+                             "versions": list(range(1, want + 1))},
+                 registry=self.registry)
+        resp = recv_msg(self.sock, registry=self.registry)
+        if resp.get("ok"):
+            self.wire_version = int(resp.get("version", 1))
 
     def reconnect(self) -> None:
-        """Drop the (possibly broken) connection and dial again."""
+        """Drop the (possibly broken) connection and dial again (the
+        replacement server may be older/newer: re-negotiate).  The pull
+        cache is dropped too — a RESTARTED server's update counter can
+        coincide with the cached one while its center differs, and an
+        ``unchanged`` answer would then silently serve the old server's
+        center."""
         try:
             self.sock.close()
         except OSError:
             pass
+        self._last_pull = None
         self.sock = connect(self.host, self.port)
         self._c_reconnects.inc()
+        self._handshake()
 
     def _rpc(self, msg: dict, retry: bool = False) -> Any:
         """One framed request/response, rtt observed.  ``retry=True``
@@ -52,27 +109,55 @@ class PSClient:
         idempotent reads."""
         t0 = time.perf_counter()
         try:
-            send_msg(self.sock, msg, registry=self.registry)
+            send_msg(self.sock, msg, registry=self.registry,
+                     version=self.wire_version)
             resp = recv_msg(self.sock, registry=self.registry)
         except (ConnectionError, OSError):
             if not retry:
                 raise
             self.reconnect()
-            send_msg(self.sock, msg, registry=self.registry)
+            send_msg(self.sock, msg, registry=self.registry,
+                     version=self.wire_version)
             resp = recv_msg(self.sock, registry=self.registry)
         self._h_rtt.observe(time.perf_counter() - t0)
         return resp
 
     def pull(self) -> tuple:
-        """Returns ``(center_tree, server_update_counter)``."""
-        resp = self._rpc({"action": "pull", "worker_id": self.worker_id},
-                         retry=True)
-        return resp["center"], int(resp["updates"])
+        """Returns ``(center_tree, server_update_counter)``.  Carries the
+        counter of the center already held so an idle server answers
+        ``unchanged`` instead of re-shipping megabytes (ISSUE 4)."""
+        msg = {"action": "pull", "worker_id": self.worker_id}
+        if self._last_pull is not None:
+            msg["have"] = self._last_pull[1]
+        resp = self._rpc(msg, retry=True)
+        updates = int(resp["updates"])
+        if resp.get("unchanged"):
+            if self._last_pull is not None:
+                self._c_unchanged.inc()
+                return self._last_pull[0], updates
+            # the cache was invalidated mid-RPC (a transparent reconnect
+            # dropped it, but the retry resent the stale ``have``): ask
+            # again unconditionally for the full center
+            resp = self._rpc({"action": "pull",
+                              "worker_id": self.worker_id}, retry=True)
+            updates = int(resp["updates"])
+        self._last_pull = (resp["center"], updates)
+        return resp["center"], updates
 
     def commit(self, delta: Any, last_update: Optional[int] = None) -> bool:
-        """Commit a delta; returns False if a fault injector dropped it."""
+        """Commit a delta; returns False if a fault injector dropped it.
+        A non-identity codec compresses the payload here (error-feedback
+        residual updated as a side effect) — the server decodes
+        statelessly from the per-leaf stubs."""
+        if not self.codec.is_identity:
+            t0 = time.perf_counter()
+            raw = codecs.tree_payload_bytes(delta)
+            delta = self.codec.encode(delta)
+            codecs.count_codec_bytes(self.registry, raw,
+                                     codecs.tree_payload_bytes(delta))
+            self._h_encode.observe(time.perf_counter() - t0)
         msg = {"action": "commit", "worker_id": self.worker_id,
-               "delta": delta}
+               "delta": delta, "codec": self.codec.name}
         if last_update is not None:
             msg["last_update"] = int(last_update)
         resp = self._rpc(msg)
@@ -87,7 +172,8 @@ class PSClient:
 
     def close(self) -> None:
         try:
-            send_msg(self.sock, {"action": "stop"}, registry=self.registry)
+            send_msg(self.sock, {"action": "stop"}, registry=self.registry,
+                     version=self.wire_version)
             recv_msg(self.sock, registry=self.registry)
         except (ConnectionError, OSError):
             pass

@@ -2,9 +2,25 @@
 
 Same surface (``determine_host_address``, ``connect``, send/recv of whole
 messages), different wire format: the reference pickles arbitrary objects
-(``send_data``/``recv_data``); we frame **msgpack** blobs with a uint64
-length prefix via ``utils.serde`` — safe against arbitrary-code
-deserialization and identical across hosts.
+(``send_data``/``recv_data``); we frame **msgpack** blobs — safe against
+arbitrary-code deserialization and identical across hosts.
+
+Two frame formats coexist on the same port (ISSUE 4):
+
+* **v1**: ``>Q`` length prefix + one self-contained msgpack blob
+  (``serde.tree_to_bytes`` — every tensor copied into the blob).  The
+  compatibility format old workers speak.
+* **v2**: ``b"DKW2"`` magic + segment count + length table, then the
+  msgpack header and the raw tensor **segments** (``serde.tree_to_frames``)
+  sent scatter-gather via ``socket.sendmsg`` — tensor bytes go straight
+  from the arrays' buffers to the kernel, never through an intermediate
+  blob; the receiver reads each segment into its own buffer
+  (``recv_into``) and wraps it zero-copy.
+
+``recv_msg`` auto-detects the format per message (the v2 magic's first
+byte can never open a v1 length prefix below 4.9 EB), so a server accepts
+both; which format a peer may *send to you* is negotiated once per
+connection by the PS hello handshake (``ps.client`` / ``ps.servers``).
 
 Instrumented (ISSUE 2): every framed send/recv counts messages and wire
 bytes (frame header included) into an ``obs.Registry`` — the component's
@@ -18,12 +34,21 @@ from __future__ import annotations
 import socket
 import struct
 import time
-from typing import Any, Optional
+from typing import Any, List, Optional, Tuple
 
 from ..obs import default_registry
 from ..utils import serde
 
 _LEN = struct.Struct(">Q")
+_MAGIC2 = b"DKW2"
+_V2HEAD = struct.Struct(">4sI")  # magic + segment count
+
+#: newest frame format this build speaks; the hello handshake negotiates
+#: min(client, server) per connection
+WIRE_VERSION = 2
+
+#: max buffers per sendmsg call (stay well under any platform IOV_MAX)
+_IOV_CHUNK = 256
 
 
 def determine_host_address() -> str:
@@ -58,14 +83,79 @@ def connect(host: str, port: int, timeout: Optional[float] = 30.0,
     raise ConnectionError(f"cannot connect to {host}:{port}: {last}")
 
 
-def send_msg(sock: socket.socket, obj: Any, registry=None) -> None:
-    """Length-prefixed msgpack send (parity: reference ``send_data``)."""
+# ---------------------------------------------------------------------------
+# send path
+# ---------------------------------------------------------------------------
+
+def _flat_view(buf: Any) -> memoryview:
+    """Any buffer-protocol object -> flat byte view (0-d ndarrays cannot
+    cast directly; go through their 1-element reshape)."""
+    v = memoryview(buf)
+    if v.ndim == 0:
+        v = memoryview(buf.reshape(1))
+    return v.cast("B")
+
+
+def _sendmsg_all(sock: socket.socket, bufs: List[Any]) -> None:
+    """Scatter-gather send of every buffer, partial sends handled.  Falls
+    back to per-buffer ``sendall`` where ``sendmsg`` is unavailable."""
+    views = [v for v in (_flat_view(b) for b in bufs) if v.nbytes]
+    if not hasattr(sock, "sendmsg"):
+        for v in views:
+            sock.sendall(v)
+        return
+    while views:
+        chunk = views[:_IOV_CHUNK]
+        sent = sock.sendmsg(chunk)
+        # drop fully-sent buffers, slice the partially-sent one
+        while sent:
+            if sent >= len(views[0]):
+                sent -= len(views[0])
+                views.pop(0)
+            else:
+                views[0] = views[0][sent:]
+                sent = 0
+
+
+def pack_msg(obj: Any, version: int = 1) -> Tuple[List[Any], int]:
+    """Pre-serialize ``obj`` into ``(buffers, total_bytes)`` for repeated
+    :func:`send_packed` calls — the PS pull-reply cache (ISSUE 4): the
+    center is encoded ONCE per update, not once per pull.  v2 buffers hold
+    zero-copy views of the tree's tensors, safe to cache because PS
+    commits replace (never mutate) center arrays."""
+    if version >= 2:
+        header, segs = serde.tree_to_frames(obj)
+        lens = [len(header)] + [memoryview(s).nbytes for s in segs]
+        pre = _V2HEAD.pack(_MAGIC2, len(segs)) \
+            + b"".join(_LEN.pack(n) for n in lens)
+        bufs: List[Any] = [pre, header, *segs]
+        return bufs, len(pre) + sum(lens)
     blob = serde.tree_to_bytes(obj)
-    sock.sendall(_LEN.pack(len(blob)) + blob)
+    framed = _LEN.pack(len(blob)) + blob
+    return [framed], len(framed)
+
+
+def send_packed(sock: socket.socket, payload: Tuple[List[Any], int],
+                registry=None) -> None:
+    """Send a :func:`pack_msg` payload (counted like any message)."""
+    bufs, total = payload
+    _sendmsg_all(sock, bufs)
     reg = registry if registry is not None else default_registry()
     reg.counter("net.msgs_sent").inc()
-    reg.counter("net.bytes_sent").inc(_LEN.size + len(blob))
+    reg.counter("net.bytes_sent").inc(total)
 
+
+def send_msg(sock: socket.socket, obj: Any, registry=None,
+             version: int = 1) -> None:
+    """One framed message (parity: reference ``send_data``).  ``version=2``
+    uses the zero-copy scatter-gather frame; the peer must have negotiated
+    v2 (its ``recv_msg`` auto-detects either way)."""
+    send_packed(sock, pack_msg(obj, version=version), registry=registry)
+
+
+# ---------------------------------------------------------------------------
+# recv path
+# ---------------------------------------------------------------------------
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     chunks = []
@@ -78,12 +168,38 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    """Fill ``view`` from the socket — the segment read lands directly in
+    the buffer the decoded ndarray will wrap (no join, no second copy)."""
+    while view.nbytes:
+        got = sock.recv_into(view)
+        if not got:
+            raise ConnectionError("socket closed mid-message")
+        view = view[got:]
+
+
 def recv_msg(sock: socket.socket, registry=None) -> Any:
-    """Recv-all loop for one framed message (parity: reference
-    ``recv_data``)."""
-    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    msg = serde.tree_from_bytes(_recv_exact(sock, n))
+    """Recv-all loop for one framed message, v1/v2 auto-detected (parity:
+    reference ``recv_data``)."""
+    head = _recv_exact(sock, _LEN.size)
     reg = registry if registry is not None else default_registry()
+    if head[:4] == _MAGIC2:
+        _, nseg = _V2HEAD.unpack(head)
+        table = _recv_exact(sock, _LEN.size * (nseg + 1))
+        lens = [_LEN.unpack_from(table, i * _LEN.size)[0]
+                for i in range(nseg + 1)]
+        header = _recv_exact(sock, lens[0])
+        segments = []
+        for n in lens[1:]:
+            buf = bytearray(n)
+            _recv_exact_into(sock, memoryview(buf))
+            segments.append(buf)
+        msg = serde.tree_from_frames(header, segments)
+        reg.counter("net.msgs_recv").inc()
+        reg.counter("net.bytes_recv").inc(len(head) + len(table) + sum(lens))
+        return msg
+    (n,) = _LEN.unpack(head)
+    msg = serde.tree_from_bytes(_recv_exact(sock, n))
     reg.counter("net.msgs_recv").inc()
     reg.counter("net.bytes_recv").inc(_LEN.size + n)
     return msg

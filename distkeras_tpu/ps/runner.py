@@ -190,7 +190,9 @@ def _run_thread_workers(trainer, ps, server, mode, center, xs, ys, num_epoch,
         w = worker_cls(k, window_fn, variables, opt_state, rng,
                        "127.0.0.1", server.port, num_epoch,
                        device=dev, start_window=start_windows[k],
-                       metrics=trainer.metrics, **kw)
+                       metrics=trainer.metrics,
+                       comm_codec=getattr(trainer, "comm_codec", "none"),
+                       **kw)
         if stream is not None:
             w.set_stream(stream.factory(k), stream.n_windows)
         else:
@@ -220,7 +222,8 @@ def _run_thread_workers(trainer, ps, server, mode, center, xs, ys, num_epoch,
                 trainer.seed + 101 + w.worker_id), dev),
             "127.0.0.1", server.port, num_epoch, device=dev,
             start_window=ps.commits_by_worker.get(w.worker_id, 0),
-            metrics=trainer.metrics, **kw)
+            metrics=trainer.metrics,
+            comm_codec=getattr(trainer, "comm_codec", "none"), **kw)
         if stream is not None:
             retry.set_stream(stream.factory(w.worker_id), stream.n_windows)
         else:
@@ -308,6 +311,7 @@ def _run_process_workers(trainer, ps, server, mode, center, xs, ys,
             "remat": bool(trainer.remat),
             "aux_weight": float(trainer.aux_weight),
             "mode": mode,
+            "comm_codec": getattr(trainer, "comm_codec", "none"),
             "alpha": float(getattr(trainer, "alpha", 0.0)),
             "worker_id": k, "host": "127.0.0.1", "port": server.port,
             "num_epoch": num_epoch, "seed": seed,

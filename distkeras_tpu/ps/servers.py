@@ -33,7 +33,9 @@ import numpy as np
 from ..obs import COUNT_BUCKETS, TIME_BUCKETS, Registry
 from ..parallel.sync import _inexact, tmap as _tree_map
 from ..utils import native
-from .networking import recv_msg, send_msg
+from . import codecs
+from .networking import (WIRE_VERSION, pack_msg, recv_msg, send_msg,
+                         send_packed)
 
 Tree = Any
 
@@ -217,29 +219,51 @@ class SocketParameterServer:
     (parity: reference ``SocketParameterServer.run``/``handle_connection``).
 
     Protocol: each request is one framed msgpack map with an ``action`` key
-    (``pull`` / ``commit`` / ``stats`` / ``stop``); every request gets a
-    response.  ``stats`` returns the PS registry snapshot + ground-truth
-    counters without touching the center — the live-poll path
+    (``hello`` / ``pull`` / ``commit`` / ``stats`` / ``stop``); every
+    request gets a response.  ``stats`` returns the PS registry snapshot +
+    ground-truth counters without touching the center — the live-poll path
     (``PSClient.stats()``, ``scripts/obsview.py --ps``).
+
+    ISSUE 4 fast path: ``hello`` negotiates the frame format per
+    connection (v2 zero-copy scatter-gather; clients that never say hello
+    stay on v1, so old workers keep working); ``pull`` answers
+    ``unchanged`` — no center payload — when the client already holds the
+    current center, and otherwise serves a **pre-serialized center
+    payload** cached per (update counter, wire version): the center is
+    encoded once per commit, not once per pull (safe because commits
+    replace, never mutate, the center arrays the cached v2 frames
+    reference); ``commit`` decodes ``ps.codecs`` deltas statelessly.
     """
 
     def __init__(self, ps: ParameterServer, host: str = "127.0.0.1",
                  port: int = 0,
-                 fault_injector: Optional[Callable[[str, dict], bool]] = None):
+                 fault_injector: Optional[Callable[[str, dict], bool]] = None,
+                 max_wire_version: int = WIRE_VERSION):
         self.ps = ps
         self.host = host
         self.port = port
         self.fault_injector = fault_injector
+        #: newest frame format this server will negotiate; pin to 1 to
+        #: emulate (and interop-test against) a legacy v1-only server
+        self.max_wire_version = int(max_wire_version)
         self._sock: Optional[socket.socket] = None
         self._threads: list = []
         self._conns: list = []
         self._conn_lock = threading.Lock()
+        #: pre-serialized pull replies: wire version -> (num_updates,
+        #: pack_msg payload); every touch goes through _cache_lock
+        self._pull_cache: dict = {}
+        self._cache_lock = threading.Lock()
         self._running = threading.Event()
         #: front-end instruments live in the PS's registry so one snapshot
         #: covers update rules AND wire traffic
         self._g_conns = ps.registry.gauge("ps.connections")
         self._g_inflight = ps.registry.gauge("ps.inflight")
         self._c_dropped = ps.registry.counter("ps.commits_dropped")
+        self._c_unchanged = ps.registry.counter("ps.pulls_unchanged")
+        self._c_cache_hits = ps.registry.counter("ps.pull_cache_hits")
+        self._h_decode = ps.registry.histogram("ps.codec.decode_seconds",
+                                               TIME_BUCKETS)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "SocketParameterServer":
@@ -304,8 +328,44 @@ class SocketParameterServer:
             with self._conn_lock:
                 self._threads.append(t)
 
+    def _center_payload(self, center, updates: int, ver: int):
+        """Pre-serialized pull reply for this (counter, wire version) —
+        built once per commit, served to every puller.  The payload is
+        encoded OUTSIDE the cache lock so a slow big-model serialization
+        never serializes concurrent pulls of an already-cached center."""
+        with self._cache_lock:
+            ent = self._pull_cache.get(ver)
+            if ent is not None and ent[0] == updates:
+                self._c_cache_hits.inc()
+                return ent[1]
+        payload = pack_msg({"center": center, "updates": updates},
+                           version=ver)
+        with self._cache_lock:
+            cur = self._pull_cache.get(ver)
+            # never regress: a racing handler may have cached a NEWER
+            # center; replacing it with this older snapshot would hand a
+            # committed worker a pre-commit center on its next pull
+            if cur is None or updates >= cur[0]:
+                self._pull_cache[ver] = (updates, payload)
+        return payload
+
+    def _decoded_delta(self, msg: dict):
+        """Commit delta, codec stubs decoded (latency + bytes observed)."""
+        delta = msg.get("delta")
+        if msg.get("codec") in (None, "none"):
+            return delta
+        reg = self.ps.registry
+        t0 = time.perf_counter()
+        enc_bytes = codecs.tree_payload_bytes(delta)
+        delta = codecs.decode_tree(delta)
+        codecs.count_codec_bytes(reg, codecs.tree_payload_bytes(delta),
+                                 enc_bytes)
+        self._h_decode.observe(time.perf_counter() - t0)
+        return delta
+
     def _handle_connection(self, conn: socket.socket):
         reg = self.ps.registry
+        ver = 1  # per-connection wire version; hello upgrades it
         try:
             while self._running.is_set():
                 try:
@@ -315,29 +375,49 @@ class SocketParameterServer:
                 action = msg.get("action")
                 self._g_inflight.inc()
                 try:
-                    if action == "pull":
-                        center, updates = self.ps.pull()
-                        send_msg(conn, {"center": center, "updates": updates},
+                    if action == "hello":
+                        offered = [int(v) for v in msg.get("versions", [1])]
+                        ver = max(v for v in offered + [1]
+                                  if v <= self.max_wire_version)
+                        # the reply itself stays v1-framed: the client
+                        # switches only after reading it
+                        send_msg(conn, {"ok": True, "version": ver},
                                  registry=reg)
+                    elif action == "pull":
+                        have = msg.get("have")
+                        center, updates = self.ps.pull()
+                        if have is not None and int(have) == updates:
+                            self._c_unchanged.inc()
+                            send_msg(conn, {"unchanged": True,
+                                            "updates": updates},
+                                     registry=reg, version=ver)
+                        else:
+                            send_packed(conn,
+                                        self._center_payload(center, updates,
+                                                             ver),
+                                        registry=reg)
                     elif action == "commit":
                         dropped = bool(
                             self.fault_injector and
                             self.fault_injector("commit", msg))
                         if not dropped:
-                            self.ps.handle_commit(msg["delta"], msg)
+                            self.ps.handle_commit(self._decoded_delta(msg),
+                                                  msg)
                         else:
                             self._c_dropped.inc()
                         send_msg(conn, {"ok": True, "dropped": dropped},
-                                 registry=reg)
+                                 registry=reg, version=ver)
                     elif action == "stats":
-                        send_msg(conn, self.ps.stats(), registry=reg)
+                        send_msg(conn, self.ps.stats(), registry=reg,
+                                 version=ver)
                     elif action == "stop":
-                        send_msg(conn, {"ok": True}, registry=reg)
+                        send_msg(conn, {"ok": True}, registry=reg,
+                                 version=ver)
                         return
                     else:
                         send_msg(conn, {"ok": False,
                                         "error": f"unknown action {action!r}"},
-                                 registry=reg)
+                                 registry=reg, version=ver)
                 finally:
                     self._g_inflight.dec()
         finally:

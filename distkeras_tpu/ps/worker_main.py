@@ -12,6 +12,7 @@ The spec is a msgpack tree (``utils.serde``):
     {"model_blob": <serialize_model bytes>,
      "worker_optimizer": str, "loss": str, "learning_rate": float,
      "compute_dtype": str|None, "mode": "pull_commit"|"staleness"|"elastic",
+     "comm_codec": str (``ps.codecs`` spec, default "none"),
      "alpha": float, "worker_id": int, "host": str, "port": int,
      "num_epoch": int, "seed": int, "data_npz": path, "out_npz": path}
 
@@ -70,7 +71,8 @@ def run_spec(spec_path: str) -> None:
         optimizer.init(center["params"]),
         jax.random.PRNGKey(int(spec["seed"])),
         spec["host"], int(spec["port"]), int(spec["num_epoch"]),
-        start_window=int(spec.get("start_window", 0)), **kw)
+        start_window=int(spec.get("start_window", 0)),
+        comm_codec=spec.get("comm_codec", "none"), **kw)
     if "stream" in spec:
         # disk-streaming partition: this process reads ITS shards straight
         # from the (shared) dataset directory — nothing was staged for it

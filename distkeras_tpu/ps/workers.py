@@ -50,7 +50,8 @@ class AsyncWorker(threading.Thread):
     def __init__(self, worker_id: int, window_fn: Callable,
                  variables: Tree, opt_state: Tree, rng,
                  host: str, port: int, num_epoch: int,
-                 device=None, start_window: int = 0, metrics=None):
+                 device=None, start_window: int = 0, metrics=None,
+                 comm_codec: str = "none"):
         super().__init__(name=f"worker-{worker_id}", daemon=True)
         self.worker_id = worker_id
         self.window_fn = window_fn
@@ -61,6 +62,9 @@ class AsyncWorker(threading.Thread):
         self.ps_port = port
         self.num_epoch = num_epoch
         self.device = device
+        #: delta-compression codec spec (``ps.codecs``): the client built
+        #: in ``run()`` owns the stateful error-feedback instance
+        self.comm_codec = comm_codec
         #: optional shared JSONL sink (``MetricsLogger`` — thread-safe):
         #: one ``heartbeat`` record per committed window, so a stalled or
         #: straggling worker is visible IN-RUN, not post-mortem (ISSUE 2)
@@ -94,7 +98,8 @@ class AsyncWorker(threading.Thread):
 
     def run(self):
         try:
-            client = PSClient(self.ps_host, self.ps_port, self.worker_id)
+            client = PSClient(self.ps_host, self.ps_port, self.worker_id,
+                              codec=self.comm_codec)
             try:
                 self._train(client)
             finally:

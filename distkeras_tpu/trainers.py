@@ -424,7 +424,8 @@ class DistributedTrainer(Trainer):
                  communication_window: Optional[int] = None,
                  learning_rate: float = 0.01, seed: int = 0,
                  mode: str = "sync", mesh=None,
-                 async_workers: str = "threads", **kw):
+                 async_workers: str = "threads",
+                 comm_codec: str = "none", **kw):
         super().__init__(keras_model, worker_optimizer, loss, features_col,
                          label_col, num_epoch, batch_size, learning_rate, seed,
                          **kw)
@@ -443,6 +444,18 @@ class DistributedTrainer(Trainer):
         #: or one OS process per worker — the reference's deployment shape
         #: (Spark executor tasks); see ``ps.runner`` / ``ps.worker_main``.
         self.async_workers = async_workers
+        #: async-mode commit compression (``ps.codecs``): "none" (default,
+        #: bit-identical numerics), "int8", "bf16", or "topk<frac>" —
+        #: quantized deltas with worker-side error feedback (ISSUE 4).
+        #: Sync mode communicates on-device (ICI collectives); no codec.
+        from .ps.codecs import Codec, get_codec
+        if isinstance(comm_codec, Codec):
+            # a Codec INSTANCE carries per-worker mutable error-feedback
+            # state and cannot be shared by N workers (racing residuals);
+            # keep only its spec — every worker builds its own instance
+            comm_codec = comm_codec.name
+        get_codec(comm_codec)  # validate the spec at construction time
+        self.comm_codec = comm_codec
 
     # -- algorithm hooks ----------------------------------------------------
     def _sync_algorithm(self):

@@ -6,18 +6,32 @@ weight ndarrays — and ``distkeras/networking.py:send_data/recv_data`` which
 pickle arbitrary objects).  We use msgpack with an explicit, versioned
 ndarray encoding instead of pickle: safe to use as a wire format for the
 async parameter server and as the checkpoint format.
+
+Two encodings share the ndarray leaf convention:
+
+* **v1, inline** (``tree_to_bytes``/``tree_from_bytes``): one
+  self-contained msgpack blob; every tensor's bytes are copied into it
+  via ``tobytes()``.  The checkpoint/model-blob format, and the
+  compatibility wire format.
+* **v2, framed** (``tree_to_frames``/``tree_from_frames``): the msgpack
+  header holds only dtype/shape/segment-index stubs and the tensor bytes
+  travel as out-of-band **segments** — zero-copy ``memoryview``s of the
+  arrays' own buffers, handed to ``socket.sendmsg`` scatter-gather by
+  ``ps.networking``.  The PS hot-path wire format (ISSUE 4): a pull or
+  commit never copies its tensors into an intermediate blob.
 """
 
 from __future__ import annotations
 
 import json
-from typing import Any
+from typing import Any, List, Tuple
 
 import jax.numpy as jnp
 import msgpack
 import numpy as np
 
-_ND = "__nd__"  # ndarray marker key
+_ND = "__nd__"      # v1: inline ndarray marker key
+_NDSEG = "__ndseg__"  # v2: out-of-band segment stub marker key
 
 
 def _default(obj):
@@ -56,6 +70,67 @@ def tree_to_bytes(tree: Any) -> bytes:
 
 def tree_from_bytes(data: bytes) -> Any:
     return msgpack.unpackb(data, object_hook=_object_hook, raw=False,
+                           strict_map_key=False)
+
+
+# ---------------------------------------------------------------------------
+# v2 framed encoding — zero-copy tensor segments (ISSUE 4 fast path)
+# ---------------------------------------------------------------------------
+
+def _segment_view(arr: np.ndarray) -> Tuple[str, np.ndarray]:
+    """(dtype tag, buffer-protocol view) for one ndarray.  bfloat16 has no
+    buffer-protocol support, so it ships as its uint16 bit pattern (same
+    rule as the v1 inline encoding)."""
+    if arr.dtype == np.dtype("bfloat16"):
+        return "bfloat16", arr.view(np.uint16)
+    return arr.dtype.str, arr
+
+
+def tree_to_frames(tree: Any) -> Tuple[bytes, List[Any]]:
+    """Serialize a pytree to ``(header, segments)``.
+
+    ``header`` is a msgpack blob in which every ndarray leaf is replaced
+    by a ``{_NDSEG: i, dtype, shape}`` stub; ``segments[i]`` is a
+    buffer-protocol view (ndarray / memoryview) over the i-th tensor's
+    bytes — NOT a copy.  Non-contiguous arrays are the one exception
+    (compacted first; wire deltas/centers are always contiguous).
+    """
+    segments: List[Any] = []
+
+    def default(obj):
+        if isinstance(obj, (np.ndarray, jnp.ndarray)):
+            arr = np.asarray(obj)
+            if not arr.flags.c_contiguous:  # ascontiguousarray would also
+                arr = np.ascontiguousarray(arr)  # promote 0-d to 1-d
+            dtype, view = _segment_view(arr)
+            stub = {_NDSEG: len(segments), "dtype": dtype,
+                    "shape": list(arr.shape)}
+            segments.append(view)
+            return stub
+        return _default(obj)
+
+    header = msgpack.packb(tree, default=default, use_bin_type=True)
+    return header, segments
+
+
+def tree_from_frames(header: bytes, segments: List[Any]) -> Any:
+    """Inverse of :func:`tree_to_frames`.  ``segments`` may be any
+    buffer-protocol objects (``bytearray`` straight off ``recv_into``):
+    leaves are ``np.frombuffer`` views over them — zero additional
+    copies after the socket read."""
+
+    def hook(obj):
+        if _NDSEG in obj:
+            buf = segments[obj[_NDSEG]]
+            if obj["dtype"] == "bfloat16":
+                arr = np.frombuffer(buf, dtype=np.uint16).view(
+                    jnp.bfloat16.dtype)
+            else:
+                arr = np.frombuffer(buf, dtype=np.dtype(obj["dtype"]))
+            return arr.reshape(obj["shape"])
+        return _object_hook(obj)
+
+    return msgpack.unpackb(header, object_hook=hook, raw=False,
                            strict_map_key=False)
 
 

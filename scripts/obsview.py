@@ -12,6 +12,11 @@ Two modes:
   snapshot (``--prometheus`` renders Prometheus text instead — pipe it
   anywhere that scrapes the standard format).
 
+The file mode also accepts a persisted registry-snapshot JSON (the
+``BENCH_PS_OBS.json`` that ``bench.py --ps`` writes beside BENCH_r*.json):
+per-registry instrument tables plus the commit-codec accounting
+(compression ratio, bytes saved — ISSUE 4).
+
 Everything renders through pure functions over plain records
 (``summarize`` / ``summarize_stats``) so tests — and notebooks — can call
 them directly on synthetic data.
@@ -61,6 +66,33 @@ def load_records(path: str) -> list:
             if line:
                 records.append(json.loads(line))
     return records
+
+
+def load_snapshot(path: str):
+    """Registry-snapshot JSON file -> dict, or None if the file is a
+    JSONL record stream (record streams have an ``event`` key per line;
+    snapshot files never do).  Classifies from the FIRST line alone —
+    a metrics JSONL can be hundreds of MB and every line of it parses,
+    so only a multi-line pretty-printed document (whose first line is
+    not valid JSON) pays a whole-file parse."""
+    with open(path) as f:
+        first = f.readline().strip()
+        if first:
+            try:
+                doc = json.loads(first)
+            except ValueError:
+                pass  # pretty-printed JSON: fall through to a full parse
+            else:
+                if not isinstance(doc, dict) or "event" in doc:
+                    return None  # a JSONL record stream
+                # single-line dict: snapshot iff nothing follows it
+                return None if f.read().strip() else doc
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except ValueError:
+        return None
+    return doc if isinstance(doc, dict) and "event" not in doc else None
 
 
 def _sparkline(values) -> str:
@@ -116,6 +148,27 @@ def _staleness_lines(hist: dict) -> list:
                 else f"> {bounds[-2]:g}"
             bar = "#" * max(1, round(c / width * 40))
             lines.append(f"{label:>10}  {c:>8}  {bar}")
+    return lines
+
+
+def _codec_lines(stats: dict) -> list:
+    """Commit-codec accounting from a registry snapshot (ISSUE 4): bytes
+    saved, compression ratio, encode/decode latency."""
+    raw = stats.get("ps.codec.bytes_raw", {}).get("value", 0)
+    enc = stats.get("ps.codec.bytes_encoded", {}).get("value", 0)
+    if not enc:
+        return []
+    saved = stats.get("ps.codec.bytes_saved", {}).get("value", 0)
+    lines = ["== Commit codec ==",
+             f"bytes saved: {saved:,.0f}   compression: {raw / enc:.2f}x "
+             f"({raw:,.0f} raw -> {enc:,.0f} encoded)"]
+    for key, label in (("ps.codec.encode_seconds", "encode"),
+                       ("ps.codec.decode_seconds", "decode")):
+        h = stats.get(key)
+        if h and h.get("count"):
+            lines.append(f"{label:>12}: n={h['count']} mean "
+                         f"{_fmt_seconds(h['sum'] / h['count'])}  p99 "
+                         f"{_fmt_seconds(snapshot_quantile(h, 0.99))}")
     return lines
 
 
@@ -190,6 +243,8 @@ def summarize(records: list) -> str:
         lines.append(f"updates: {last.get('num_updates')}   "
                      f"commits_by_worker: {last.get('commits_by_worker')}")
         for key, label in (("ps.commits", "commits"), ("ps.pulls", "pulls"),
+                           ("ps.pulls_unchanged", "unchanged"),
+                           ("ps.pull_cache_hits", "cache_hits"),
                            ("ps.commits_dropped", "dropped"),
                            ("net.bytes_sent", "bytes_sent"),
                            ("net.bytes_recv", "bytes_recv")):
@@ -203,6 +258,7 @@ def summarize(records: list) -> str:
                     f"{_fmt_seconds(h['sum'] / h['count'])}  p99 "
                     f"{_fmt_seconds(snapshot_quantile(h, 0.99))}")
         sections.append(lines)
+        sections.append(_codec_lines(stats))
     if spans:
         sections.append(_top_spans(spans))
     if heartbeats:
@@ -211,13 +267,9 @@ def summarize(records: list) -> str:
     return "\n".join("\n".join(s) for s in sections if s)
 
 
-def summarize_stats(reply: dict) -> str:
-    """Live-poll summary from a ``stats`` RPC reply."""
-    stats = reply.get("stats", {})
-    lines = [f"== Live PS ({reply.get('server', '?')}, "
-             f"{reply.get('num_workers', '?')} workers) ==",
-             f"updates: {reply.get('num_updates')}   commits_by_worker: "
-             f"{reply.get('commits_by_worker')}"]
+def _instrument_lines(stats: dict) -> list:
+    """One line per instrument in a registry snapshot."""
+    lines = []
     for name in sorted(stats):
         s = stats[name]
         if s["type"] == "histogram":
@@ -231,6 +283,44 @@ def summarize_stats(reply: dict) -> str:
                 lines.append(f"{name}: n=0")
         else:
             lines.append(f"{name}: {s['value']:g}")
+    return lines
+
+
+def _is_registry_snapshot(d) -> bool:
+    return isinstance(d, dict) and bool(d) and all(
+        isinstance(v, dict) and "type" in v for v in d.values())
+
+
+def summarize_snapshot(doc: dict) -> str:
+    """Summary of a persisted registry-snapshot file (the
+    ``BENCH_PS_OBS.json`` bench_ps writes beside BENCH_r*.json): one
+    section per component registry, codec accounting surfaced."""
+    sections = []
+    if isinstance(doc.get("config"), dict):
+        sections.append(["== Config ==",
+                         "  ".join(f"{k}={v}" for k, v in
+                                   sorted(doc["config"].items()))])
+    named = {k: v for k, v in doc.items() if _is_registry_snapshot(v)}
+    if not named and _is_registry_snapshot(doc):
+        named = {"registry": doc}
+    for name, snap in sorted(named.items()):
+        sections.append([f"== {name} registry =="] + _instrument_lines(snap))
+        sections.append(_codec_lines(snap))
+    return "\n".join("\n".join(s) for s in sections if s)
+
+
+def summarize_stats(reply: dict) -> str:
+    """Live-poll summary from a ``stats`` RPC reply."""
+    stats = reply.get("stats", {})
+    lines = [f"== Live PS ({reply.get('server', '?')}, "
+             f"{reply.get('num_workers', '?')} workers) ==",
+             f"updates: {reply.get('num_updates')}   commits_by_worker: "
+             f"{reply.get('commits_by_worker')}"]
+    lines.extend(_instrument_lines(stats))
+    codec = _codec_lines(stats)
+    if codec:
+        lines.append("")
+        lines.extend(codec)
     if "ps.staleness" in stats:
         lines.append("")
         lines.extend(_staleness_lines(stats["ps.staleness"]))
@@ -267,6 +357,24 @@ def main(argv=None) -> int:
              else summarize_stats(reply))
         return 0
 
+    snap = load_snapshot(args.jsonl)
+    if snap is not None:
+        if args.prometheus:
+            # a snapshot file may hold several component registries;
+            # fold them with the registry merge semantics (counters/
+            # histograms add, gauges last-write) so the exposition has
+            # no duplicate metric names
+            from distkeras_tpu.obs import Registry
+            regs = [v for v in snap.values() if _is_registry_snapshot(v)]
+            if not regs and _is_registry_snapshot(snap):
+                regs = [snap]
+            if not regs:
+                emit("no registry snapshot in file", err=True)
+                return 1
+            emit(to_prometheus_text(Registry.merge_snapshots(*regs)))
+            return 0
+        emit(summarize_snapshot(snap))
+        return 0
     records = load_records(args.jsonl)
     if args.prometheus:
         ps_stats = [r for r in records if r.get("event") == "ps_stats"]

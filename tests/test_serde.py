@@ -18,6 +18,41 @@ def _tree_equal(a, b):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
+import pytest
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "bfloat16",
+                                   "float16", "int8", "int32", "int64",
+                                   "uint8", "bool"])
+@pytest.mark.parametrize("codec", ["v1", "v2"])
+def test_tree_roundtrip_dtype_property(dtype, codec):
+    """Both wire encodings preserve dtype, shape and bits for every
+    supported leaf dtype (ISSUE 4 satellite: property round-trips)."""
+    rng = np.random.default_rng(7)
+    if dtype == "bfloat16":
+        arr = np.asarray(jnp.asarray(rng.normal(size=(4, 3)), jnp.bfloat16))
+    elif dtype == "bool":
+        arr = rng.normal(size=(4, 3)) > 0
+    elif dtype.startswith(("int", "uint")):
+        arr = rng.integers(0, 100, size=(4, 3)).astype(dtype)
+    else:
+        arr = rng.normal(size=(4, 3)).astype(dtype)
+    tree = {"x": arr, "l": [arr[0], {"d": arr[:, :1]}]}
+    if codec == "v1":
+        out = tree_from_bytes(tree_to_bytes(tree))
+    else:
+        from distkeras_tpu.utils.serde import tree_from_frames, tree_to_frames
+        header, segs = tree_to_frames(tree)
+        out = tree_from_frames(header, [bytes(memoryview(np.atleast_1d(s)))
+                                        for s in segs])
+    for got, want in ((out["x"], arr), (out["l"][0], arr[0]),
+                      (out["l"][1]["d"], arr[:, :1])):
+        got = np.asarray(got)
+        assert got.dtype == want.dtype
+        assert got.shape == want.shape
+        np.testing.assert_array_equal(got, want)
+
+
 def test_tree_roundtrip_mixed():
     tree = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
             "b": [jnp.ones((4,), jnp.bfloat16), 3, "hello"],
