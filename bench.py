@@ -24,19 +24,29 @@ workload+metric (end-to-end samples/sec with a hard final sync); the
 harness version that produced each number is recorded alongside so
 methodology changes are visible (HARNESS below).
 
-``python bench.py --ps [--codec C] [--windows N] [--mb M]`` runs the
-**PS-comms microbenchmark** instead (ISSUE 4): a localhost
-SocketParameterServer + one client doing pull/commit windows over an
-M-MB synthetic center, printing one JSON line with the commit RTT and
-wire bytes per communication window, and persisting the client+server
-obs registry snapshots beside the BENCH_r*.json files (the ROADMAP
-telemetry item) so runs can diff distributions, not just wall numbers.
+``python bench.py --ps [--codec C] [--windows N] [--mb M]
+[--ps-workers N,M,...]`` runs the **PS-comms microbenchmark** instead
+(ISSUE 4): a localhost SocketParameterServer + N concurrent clients doing
+pull/commit windows over an M-MB synthetic center, printing one JSON line
+per sweep point with the commit RTT and wire bytes per communication
+window, and persisting one MERGED client+server obs registry snapshot per
+sweep point beside the BENCH_r*.json files (the ROADMAP telemetry item)
+so runs can diff distributions, not just wall numbers.
+
+Both benches self-check against the committed baseline snapshot named in
+``OBS_BASELINE.json`` (ISSUE 5): the fresh run's registry snapshot is
+drift-diffed (``distkeras_tpu/obs/drift.py`` — counter ratios, bucket-wise
+PSI, p50/p99 shift) against the previous committed one BEFORE overwriting
+it; the drift report goes to stderr (the stdout JSON row contract is
+untouched) and the row carries ``obs_drift``.  ``scripts/obsview.py
+--diff`` exposes the same comparison standalone.
 """
 
 import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 ROOT = os.path.dirname(os.path.abspath(__file__))
@@ -63,6 +73,118 @@ ANCHOR_PATH = os.path.join(ROOT, "BENCH_ANCHOR.json")
 #: v3 = SingleTrainer with pipelined epochs + final drain (r3).
 HARNESS = "trainer_pipelined_v3"
 
+#: samples/sec buckets for the trainer-bench throughput histogram —
+#: log-spaced 100..50M; the top must clear every machine's plausible
+#: reading (dispatch-dominated toy runs report several M), else the
+#: drift gate's quantiles pin at the last bound and regressions shrink
+RATE_BUCKETS = (100, 250, 500, 1000, 2500, 5000, 10000, 25000, 50000,
+                100000, 250000, 500000, 1000000, 2500000, 5000000,
+                10000000, 25000000, 50000000)
+
+
+def _load_doc(path):
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        # a corrupt committed snapshot must degrade LOUDLY: treating it
+        # as "no baseline" would let the drift gate pass green
+        from distkeras_tpu.obs.logging import emit
+        emit(f"bench: cannot read snapshot {path}: {e}", err=True)
+        return None
+
+
+_BASELINE_CFG_CACHE: dict = {}
+
+
+def _baseline_cfg():
+    """The committed ``OBS_BASELINE.json`` drift config, parsed+validated
+    ONCE per process per path (a multi-point sweep must not re-read it —
+    or re-warn about it — per point); None (with a stderr note — silently
+    dropping the tuned thresholds would cause spurious DRIFT reports)
+    when invalid."""
+    from distkeras_tpu.obs import drift
+    from distkeras_tpu.obs.logging import emit
+    bl = os.path.join(ROOT, "OBS_BASELINE.json")
+    if bl in _BASELINE_CFG_CACHE:
+        return _BASELINE_CFG_CACHE[bl]
+    cfg = None
+    if os.path.exists(bl):
+        try:
+            cfg = drift.load_baseline(bl)
+        except (OSError, ValueError) as e:
+            emit(f"bench: ignoring invalid OBS_BASELINE.json ({e}); "
+                 "drift checks fall back to default thresholds", err=True)
+    _BASELINE_CFG_CACHE[bl] = cfg
+    return cfg
+
+
+def _baseline_snapshot_path(cfg, key: str, default_name: str) -> str:
+    """The committed baseline snapshot file for bench mode ``key``, as
+    named by the baseline config's ``snapshots`` map."""
+    name = ((cfg or {}).get("snapshots") or {}).get(key, default_name)
+    return os.path.join(ROOT, name)
+
+
+def _obs_self_check(prev_doc, new_doc, label: str, baseline) -> dict:
+    """Drift-gate a fresh obs snapshot against the previous committed one
+    (ISSUE 5): the report goes to stderr — stdout keeps the one-JSON-row
+    contract — and the returned dict rides in the row as ``obs_drift``.
+    Skipped (never a false alarm) when there is no baseline yet or the
+    configs differ (a diff across workloads measures the workload)."""
+    from distkeras_tpu.obs import drift
+    from distkeras_tpu.obs.logging import emit
+    if prev_doc is None:
+        return {"checked": False, "reason": "no baseline snapshot"}
+    if prev_doc.get("config") != new_doc.get("config"):
+        return {"checked": False, "reason": "baseline config differs"}
+    report = drift.diff_docs(prev_doc, new_doc, baseline=baseline,
+                             base_name=f"{label} (committed)",
+                             cand_name="this run")
+    emit(report.render(), err=True)
+    return {"checked": True, "drifted": report.drifted_metrics}
+
+
+def _persist_obs_snapshot(snap_path: str, obs_doc: dict, bl_cfg,
+                          base_path: str = None, check: bool = True):
+    """Self-check + clobber-guarded write, shared by both benches:
+    drift-check ``obs_doc`` against the committed baseline (``base_path``,
+    defaulting to the destination itself; ``check=False`` skips it for
+    snapshots with no designated baseline), divert a config-incompatible
+    run to a ``.variant.json`` sidecar instead of voiding the existing
+    file in place, then write.  The sidecar itself is per-run scratch —
+    only the baseline file is guarded; a later incompatible run replaces
+    the previous variant like any other bench output.  Returns
+    ``(obs_drift_row, final_path)``."""
+    drift_row = None
+    if check:
+        check_path = base_path if base_path is not None else snap_path
+        prev_base = _load_doc(check_path)
+        if prev_base is None and os.path.exists(check_path):
+            # distinct machine-readable reason: a CORRUPT committed
+            # baseline must not look like a genuinely absent one to CI
+            drift_row = {"checked": False, "reason": "baseline unreadable"}
+        else:
+            drift_row = _obs_self_check(prev_base, obs_doc,
+                                        os.path.basename(check_path),
+                                        bl_cfg)
+        prev_dest = prev_base if check_path == snap_path \
+            else _load_doc(snap_path)
+    else:
+        prev_dest = _load_doc(snap_path)
+    # divert when the destination exists but is incomparable — config
+    # mismatch OR unreadable; overwriting a corrupt committed baseline in
+    # place would quietly green the gate
+    if os.path.exists(snap_path) and (
+            prev_dest is None or
+            prev_dest.get("config") != obs_doc["config"]):
+        snap_path = os.path.splitext(snap_path)[0] + ".variant.json"
+    with open(snap_path, "w") as f:
+        json.dump(obs_doc, f, indent=1)
+    return drift_row, snap_path
+
 
 def main():
     rng = np.random.default_rng(0)
@@ -73,11 +195,19 @@ def main():
         "label": np.eye(10, dtype=np.float32)[labels],
     })
 
+    from distkeras_tpu.obs import Registry, TIME_BUCKETS
+
     trainer = SingleTrainer(
         zoo.resnet20(width=WIDTH), "sgd", "categorical_crossentropy",
         features_col="features", label_col="label",
         num_epoch=WARMUP_EPOCHS + TIMED_EPOCHS, batch_size=BATCH,
         learning_rate=0.1, compute_dtype="bfloat16")
+    # bench-scoped registry: the trainer's span durations (jit_compile /
+    # train) histogram into it, per-epoch wall/throughput observations are
+    # folded in below — the distribution snapshot the ROADMAP telemetry
+    # item wants persisted beside the wall-clock row (ISSUE 5)
+    breg = Registry()
+    trainer.tracer.registry = breg
     trainer.train(ds)
 
     epochs = [r for r in trainer.metrics.records if r["event"] == "epoch"]
@@ -85,6 +215,14 @@ def main():
     samples = STEPS_PER_EPOCH * BATCH * len(timed)
     # the epoch program is a plain single-device jit: per-chip == total here
     sps_chip = samples / sum(r["epoch_seconds"] for r in timed)
+
+    h_sec = breg.histogram("bench.epoch_seconds", TIME_BUCKETS)
+    h_rate = breg.histogram("bench.samples_per_sec", RATE_BUCKETS)
+    for r in timed:
+        h_sec.observe(r["epoch_seconds"])
+        h_rate.observe(r["samples_per_sec"])
+    breg.counter("bench.epochs").inc(len(timed))
+    breg.counter("bench.samples").inc(samples)
 
     # anchor is keyed by config so overriding BENCH_BATCH can't masquerade
     # as a regression against an incompatible workload
@@ -101,30 +239,57 @@ def main():
     entry = anchors[cfg_key]  # legacy anchors are bare floats
     anchor = entry["value"] if isinstance(entry, dict) else entry
 
+    # persist the headline bench's registry snapshot beside BENCH_r*.json
+    # (same document schema as BENCH_PS_OBS.json — obsview's snapshot-file
+    # mode reads both unchanged) and self-check against the committed one
+    obs_doc = {"config": {"mode": "trainer_bench", "batch": BATCH,
+                          "steps_per_epoch": STEPS_PER_EPOCH,
+                          "width": WIDTH, "warmup_epochs": WARMUP_EPOCHS,
+                          "timed_epochs": TIMED_EPOCHS,
+                          "harness": HARNESS},
+               "trainer": breg.snapshot()}
+    bl_cfg = _baseline_cfg()
+    snap_path = _baseline_snapshot_path(bl_cfg, "trainer_bench",
+                                        "BENCH_TRAINER_OBS.json")
+    obs_drift, snap_path = _persist_obs_snapshot(snap_path, obs_doc, bl_cfg)
+
     print(json.dumps({
         "metric": "samples/sec/chip (CIFAR-10 ResNet-20)",
         "value": round(sps_chip, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": round(sps_chip / anchor, 4),
         "harness": HARNESS,
+        "obs_snapshot": os.path.relpath(snap_path, ROOT),
+        "obs_drift": obs_drift,
     }))
 
 
 def bench_ps(codec: str = "none", windows: int = 50, mb: float = 4.0,
-             out_dir: str = ROOT, wire_version=None) -> dict:
+             out_dir: str = ROOT, wire_version=None,
+             ps_workers: int = 1) -> dict:
     """PS-comms microbenchmark (ISSUE 4 acceptance): N pull+commit windows
-    against a localhost PS over an ``mb``-megabyte synthetic center.
+    against a localhost PS over an ``mb``-megabyte synthetic center, from
+    ``ps_workers`` concurrent clients (ISSUE 5: the contention sweep point
+    — lock/accept-thread contention is exactly what single-client RTTs
+    cannot see).
 
-    Returns (and ``main`` prints) one JSON row: median/p99 commit RTT,
-    wire bytes per window, pull/commit counts, compression ratio.  The
-    client and server registry snapshots are written to
-    ``<out_dir>/BENCH_PS_OBS.json`` — the per-run snapshot persistence the
-    ROADMAP telemetry item asks for, diffable across PRs.
+    Returns (and the CLI prints) one JSON row: median/p99 commit RTT
+    across all workers, wire bytes per window, compression ratio.  One
+    MERGED registry snapshot per sweep point is written beside the
+    BENCH_r*.json files — ``BENCH_PS_OBS.json`` for the single-worker
+    point (the committed baseline), ``BENCH_PS_OBS_w<N>.json`` for
+    contention points — all in the same document schema obsview and the
+    drift gate read.
     """
     from distkeras_tpu.obs import Registry
     from distkeras_tpu.ps import PSClient, SocketParameterServer
     from distkeras_tpu.ps.servers import DeltaParameterServer
 
+    ps_workers = int(ps_workers)
+    windows = int(windows)
+    if ps_workers < 1 or windows < 1:
+        raise ValueError(f"bench_ps needs ps_workers >= 1 and windows >= 1 "
+                         f"(got {ps_workers}, {windows})")
     rng = np.random.default_rng(0)
     # 8 equal fp32 leaves totalling ~mb MB — tensor-shaped like a model,
     # not one giant blob, so framing/segment overhead is realistic
@@ -134,47 +299,97 @@ def bench_ps(codec: str = "none", windows: int = 50, mb: float = 4.0,
     delta = {"params": [{"w": (0.01 * rng.normal(size=n)).astype(np.float32)}
                         for _ in range(8)], "state": [{} for _ in range(8)]}
 
-    ps = DeltaParameterServer(center, num_workers=1)
-    creg = Registry()  # client-side instruments, isolated for the report
-    rtts = []
+    ps = DeltaParameterServer(center, num_workers=ps_workers)
+    regs = [Registry() for _ in range(ps_workers)]  # one per client thread
+    rtts = [[] for _ in range(ps_workers)]
+    wire_bytes = [0.0] * ps_workers
+    negotiated = [1] * ps_workers
+    errors: list = []
+
+    def drive(k: int) -> None:
+        try:
+            creg = regs[k]
+            with PSClient("127.0.0.1", server.port, k, registry=creg,
+                          codec=codec, wire_version=wire_version) as client:
+                negotiated[k] = client.wire_version
+                client.pull()  # connection + first center transfer warm
+                b0 = creg.counter("net.bytes_sent").value \
+                    + creg.counter("net.bytes_recv").value
+                for _ in range(windows):
+                    client.pull()
+                    t0 = time.perf_counter()
+                    client.commit(delta)
+                    rtts[k].append(time.perf_counter() - t0)
+                wire_bytes[k] = creg.counter("net.bytes_sent").value \
+                    + creg.counter("net.bytes_recv").value - b0
+        except BaseException as e:  # surfaced after join — never hang
+            errors.append(e)
+
     with SocketParameterServer(ps) as server:
-        with PSClient("127.0.0.1", server.port, 0, registry=creg,
-                      codec=codec, wire_version=wire_version) as client:
-            negotiated = client.wire_version  # what actually ran the wire
-            client.pull()  # connection + first center transfer warm
-            b0 = creg.counter("net.bytes_sent").value \
-                + creg.counter("net.bytes_recv").value
-            for _ in range(int(windows)):
-                client.pull()
-                t0 = time.perf_counter()
-                client.commit(delta)
-                rtts.append(time.perf_counter() - t0)
-            wire_bytes = creg.counter("net.bytes_sent").value \
-                + creg.counter("net.bytes_recv").value - b0
-    raw = creg.counter("ps.codec.bytes_raw").value
-    enc = creg.counter("ps.codec.bytes_encoded").value
+        threads = [threading.Thread(target=drive, args=(k,),
+                                    name=f"bench-ps-{k}")
+                   for k in range(ps_workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    if errors:
+        raise errors[0]
+
+    merged = Registry.merge_snapshots(*[r.snapshot() for r in regs])
+
+    def _counter(snap, name):
+        return snap.get(name, {}).get("value", 0.0)
+
+    raw = _counter(merged, "ps.codec.bytes_raw")
+    enc = _counter(merged, "ps.codec.bytes_encoded")
+    all_rtts = np.concatenate([np.asarray(r) for r in rtts])
+    total_windows = ps_workers * windows
     row = {
         "metric": "ps commit RTT (localhost, "
-                  f"{mb:g} MB center, codec={codec})",
-        "mode": "bench_ps", "codec": codec, "windows": int(windows),
+                  f"{mb:g} MB center, codec={codec}, "
+                  f"workers={ps_workers})",
+        "mode": "bench_ps", "codec": codec, "windows": windows,
+        "ps_workers": ps_workers,
         "center_mb": round(mb, 3),
-        "commit_rtt_ms_p50": round(float(np.median(rtts)) * 1e3, 3),
-        "commit_rtt_ms_p99": round(float(np.quantile(rtts, 0.99)) * 1e3, 3),
-        "wire_bytes_per_window": round(wire_bytes / max(1, int(windows))),
-        #: as NEGOTIATED on the live connection (env pins like DKTPU_WIRE=1
-        #: and server refusals included) — benchmark provenance must name
-        #: the frame format that actually carried the traffic
-        "wire_version": negotiated,
+        "commit_rtt_ms_p50": round(float(np.median(all_rtts)) * 1e3, 3),
+        "commit_rtt_ms_p99": round(float(np.quantile(all_rtts, 0.99)) * 1e3,
+                                   3),
+        "wire_bytes_per_window": round(sum(wire_bytes)
+                                       / max(1, total_windows)),
+        #: as NEGOTIATED on the live connections (env pins like
+        #: DKTPU_WIRE=1 and server refusals included) — benchmark
+        #: provenance must name the frame format that carried the traffic
+        "wire_version": min(negotiated),
         "compression_ratio": round(raw / enc, 3) if enc else 1.0,
-        "bytes_saved": creg.counter("ps.codec.bytes_saved").value,
+        "bytes_saved": _counter(merged, "ps.codec.bytes_saved"),
     }
-    snap_path = os.path.join(out_dir, "BENCH_PS_OBS.json")
-    with open(snap_path, "w") as f:
-        json.dump({"config": {k: row[k] for k in
-                              ("codec", "windows", "center_mb",
-                               "wire_version")},
-                   "client": creg.snapshot(),
-                   "server": ps.registry.snapshot()}, f, indent=1)
+    # the single-worker snapshot name follows OBS_BASELINE.json's
+    # ``snapshots.ps_bench`` mapping so a remapped baseline is both
+    # checked against AND refreshed (the trainer bench does the same)
+    bl_cfg = _baseline_cfg()
+    base_path = _baseline_snapshot_path(bl_cfg, "ps_bench",
+                                        "BENCH_PS_OBS.json")
+    name = os.path.basename(base_path) if ps_workers == 1 \
+        else f"BENCH_PS_OBS_w{ps_workers}.json"
+    snap_path = os.path.join(out_dir, name)
+    obs_doc = {"config": {k: row[k] for k in
+                          ("codec", "windows", "center_mb", "ps_workers",
+                           "wire_version")},
+               "client": merged,
+               "server": ps.registry.snapshot()}
+    # self-check + clobber guard for the single-worker baseline point;
+    # contention points get the clobber guard only (no designated
+    # baseline to check against, but a committed w<N> snapshot must not
+    # be silently replaced by a config-incompatible run either)
+    if ps_workers == 1:
+        row["obs_drift"], snap_path = _persist_obs_snapshot(
+            snap_path, obs_doc, bl_cfg, base_path=base_path)
+    else:
+        row["obs_drift"] = {"checked": False,
+                            "reason": "no designated baseline"}
+        _, snap_path = _persist_obs_snapshot(snap_path, obs_doc, bl_cfg,
+                                             check=False)
     row["snapshot"] = os.path.relpath(snap_path, ROOT)
     return row
 
@@ -193,10 +408,26 @@ def _cli(argv=None) -> int:
     ap.add_argument("--wire", type=int, default=None, choices=(1, 2),
                     help="bench_ps: pin the frame format (default: "
                          "negotiate v2)")
+    ap.add_argument("--ps-workers", default="1",
+                    help="bench_ps: comma-separated concurrent-client "
+                         "sweep points (e.g. 1,2,4); one JSON row and one "
+                         "merged registry snapshot per point")
     args = ap.parse_args(argv)
     if args.ps:
-        print(json.dumps(bench_ps(codec=args.codec, windows=args.windows,
-                                  mb=args.mb, wire_version=args.wire)))
+        try:
+            points = [int(p) for p in str(args.ps_workers).split(",") if p]
+        except ValueError:
+            ap.error(f"--ps-workers expects ints, got {args.ps_workers!r}")
+        if not points or any(p < 1 for p in points):
+            ap.error(f"--ps-workers needs positive sweep points "
+                     f"(got {args.ps_workers!r})")
+        if args.windows < 1:
+            ap.error(f"--windows must be >= 1 (got {args.windows})")
+        for n in points:
+            print(json.dumps(bench_ps(codec=args.codec,
+                                      windows=args.windows, mb=args.mb,
+                                      wire_version=args.wire,
+                                      ps_workers=n)))
         return 0
     main()
     return 0

@@ -10,6 +10,12 @@ stack exposes a live ``STATS`` RPC returning a registry snapshot, the
 networking layer counts bytes/round-trips, streaming counts
 batches/stalls, trainers split compile time from steady-state and async
 workers heartbeat — all readable by ``scripts/obsview.py``.
+
+On top of the raw telemetry sits the regression-tracking layer (ISSUE 5):
+``drift`` diffs persisted registry snapshots across runs (counter ratio
+deltas, bucket-wise PSI + quantile shift, thresholds from the committed
+``OBS_BASELINE.json``) and ``stragglers`` turns per-window worker
+heartbeat gaps into a live ``ps.stragglers`` gauge.
 """
 
 from .registry import (  # noqa: F401
@@ -25,3 +31,13 @@ from .registry import (  # noqa: F401
 from .spans import SpanTracer, default_tracer, set_default_sink, span  # noqa: F401
 from .exposition import to_prometheus_text  # noqa: F401
 from .logging import emit, enable_stderr_logging, get_logger  # noqa: F401
+from .stragglers import StragglerDetector, detect_from_heartbeats  # noqa: F401
+from .drift import (  # noqa: F401
+    BASELINE_SCHEMA,
+    DEFAULT_THRESHOLDS,
+    DriftReport,
+    diff_docs,
+    diff_files,
+    find_baseline,
+    load_baseline,
+)

@@ -13,6 +13,15 @@ from a single file).  Each record carries the span name, its full
                   #     "path": "train/jit_compile", "depth": 1,
                   #     "seconds": 1.83}
 
+Every span additionally carries identity (ISSUE 5): a thread-local
+``trace_id`` (settable — async workers pin theirs to ``w<worker_id>`` so
+one trace follows one worker) and a per-span ``span_id``; nested spans
+record the enclosing span as ``parent_span``.  The ids are what lets a
+span CROSS a process boundary: the PS client ships its open commit span's
+``(trace_id, span_id)`` over the wire and the server's apply span adopts
+them as its ``trace_id``/``parent_span`` — ``scripts/obsview.py`` then
+links server applies back to the worker windows that caused them.
+
 Optionally a ``Registry`` accumulates per-name duration histograms
 (``span.<name>.seconds``) so cumulative span time shows up in ``STATS``
 snapshots too.  A process-wide default tracer (``obs.span``) serves ad-hoc
@@ -22,11 +31,26 @@ call sites; components that own a metrics sink build their own.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import threading
 import time
-from typing import Optional
+import uuid
+from typing import Optional, Tuple
 
 from .registry import Registry, TIME_BUCKETS
+
+#: span ids are ``<trace_id>.<salt><seq>``: a process-wide monotone
+#: counter plus a per-process random salt.  The salt is what keeps ids
+#: unique when several PROCESSES (or sequential runs) append to one JSONL
+#: sink under the same pinned trace tag (``w0`` restarts with the worker)
+#: — without it, run 2's ``w0.5`` would collide with run 1's and obsview
+#: would link spans across runs.
+_SPAN_SEQ = itertools.count(1)
+#: 8 hex chars = 32 bits: birthday collision across runs sharing a sink
+#: stays negligible into the tens of thousands of appended runs (4 chars
+#: would collide ~50% by ~256 runs, and colliding runs collide id-for-id
+#: because the sequence restarts at 1)
+_SPAN_SALT = uuid.uuid4().hex[:8]
 
 
 class SpanTracer:
@@ -49,33 +73,73 @@ class SpanTracer:
         return len(self._stack())
 
     def current_path(self) -> str:
-        return "/".join(self._stack())
+        return "/".join(name for name, _ in self._stack())
+
+    # -- trace identity ------------------------------------------------------
+    def set_trace_id(self, trace_id: str) -> None:
+        """Pin THIS thread's trace id (e.g. ``w3`` for async worker 3) —
+        every span the thread opens afterwards belongs to that trace."""
+        self._local.trace_id = str(trace_id)
+
+    def trace_id(self) -> str:
+        """This thread's trace id (lazily minted when never pinned)."""
+        tid = getattr(self._local, "trace_id", None)
+        if tid is None:
+            tid = self._local.trace_id = f"t{uuid.uuid4().hex[:8]}"
+        return tid
+
+    def current_span_id(self) -> Optional[str]:
+        stack = self._stack()
+        return stack[-1][1] if stack else None
+
+    def context(self) -> Tuple[str, Optional[str]]:
+        """``(trace_id, current_span_id)`` — the wire header the PS client
+        attaches to commit/pull RPCs so remote spans can link back here."""
+        return self.trace_id(), self.current_span_id()
 
     @contextlib.contextmanager
     def span(self, name: str, **fields):
         """Time a scope; emits on exit (exceptions included — a crashed
-        span still records its duration, flagged ``error=True``)."""
+        span still records its duration, flagged ``error=True``).
+        ``trace_id``/``parent_span`` keyword fields override the automatic
+        thread-local ones — the server-side hook for adopting a REMOTE
+        caller's trace context."""
         stack = self._stack()
-        stack.append(name)
-        path = "/".join(stack)
+        # a span adopting a REMOTE trace (explicit trace_id field — the
+        # server-side hook) mints its id under THAT trace, so span-id
+        # prefixes never name a trace absent from the stream
+        tid = fields.get("trace_id") or self.trace_id()
+        span_id = f"{tid}.{_SPAN_SALT}{next(_SPAN_SEQ)}"
+        parent = stack[-1][1] if stack else None
+        stack.append((name, span_id))
+        path = "/".join(n for n, _ in stack)
         depth = len(stack) - 1
         t0 = time.perf_counter()
         try:
             yield self
         except BaseException:
             self._emit(name, path, depth, time.perf_counter() - t0,
-                       dict(fields, error=True))
+                       span_id, parent, dict(fields, error=True))
             raise
         else:
-            self._emit(name, path, depth, time.perf_counter() - t0, fields)
+            self._emit(name, path, depth, time.perf_counter() - t0,
+                       span_id, parent, fields)
         finally:
             stack.pop()
 
     def _emit(self, name: str, path: str, depth: int, seconds: float,
-              fields: dict) -> None:
+              span_id: str, parent: Optional[str], fields: dict) -> None:
         if self.sink is not None:
-            self.sink.log("span", name=name, path=path, depth=depth,
-                          seconds=seconds, **fields)
+            rec = dict(fields)
+            # only the trace-adoption keys are caller-overridable; the
+            # structural keys below are authoritative (a field named
+            # "seconds" must not silently replace the measured duration)
+            rec.setdefault("trace_id", self.trace_id())
+            if parent is not None:
+                rec.setdefault("parent_span", parent)
+            rec.update(name=name, path=path, depth=depth, seconds=seconds,
+                       span_id=span_id)
+            self.sink.log("span", **rec)
         if self.registry is not None:
             self.registry.histogram(f"span.{name}.seconds",
                                     TIME_BUCKETS).observe(seconds)

@@ -1,0 +1,185 @@
+"""Straggler detection over per-window worker heartbeats (ISSUE 5).
+
+The async trainers fail *statistically*: a slow worker never raises — it
+just stretches the staleness/latency distributions (the exact failure
+mode the paper's DynSGD rule exists to tolerate).  This module turns the
+per-window heartbeat cadence the workers already emit into a live signal:
+
+* ``StragglerDetector`` keeps a rolling EWMA of each worker's
+  heartbeat gap (monotonic seconds between committed windows, shipped on
+  the commit RPC as ``gap_s``) and flags any worker whose EWMA exceeds
+  ``k×`` the fleet median.  Flagged count lands in a ``ps.stragglers``
+  gauge (visible in the live ``stats`` RPC / ``obsview --ps``), per-worker
+  EWMAs in ``ps.heartbeat_gap_ewma.worker<k>`` gauges, and the FIRST time
+  a worker is flagged a single warn log names it — one line per incident,
+  not one per window.
+
+* ``detect_from_heartbeats`` replays the same detector over a recorded
+  JSONL heartbeat stream (records carrying ``worker_id``/``gap_s``) — the
+  post-mortem path ``scripts/obsview.py`` uses on run files.
+
+Thresholding is median-relative, not absolute: window wall time is
+workload-dependent, but the *fleet* trains identical windows, so a worker
+k× slower than the median is anomalous at any absolute scale.  The
+``min_gap_s`` floor keeps sub-millisecond jitter on toy workloads from
+flagging anything.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import statistics
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from .logging import get_logger
+from .registry import Registry
+
+
+def _loo_median(vals_sorted: Sequence[float], i: int) -> float:
+    """Median of ``vals_sorted`` with the element at index ``i`` removed
+    (for equal values any occurrence's removal leaves the same multiset).
+    Index math over the shared sort — the O(1) inner step that keeps the
+    per-commit re-evaluation at one sort total."""
+    m = len(vals_sorted) - 1
+
+    def at(j: int) -> float:  # j-th element of the remainder
+        return vals_sorted[j if j < i else j + 1]
+
+    if m % 2:                        # odd remainder: single middle value
+        return at(m // 2)
+    return (at(m // 2 - 1) + at(m // 2)) / 2.0
+
+
+class StragglerDetector:
+    """Rolling heartbeat-gap EWMA per worker, fleet-median flagging.
+
+    ``record(worker_id, gap_s)`` is called once per committed window (the
+    PS server feeds it from the commit RPC's ``gap_s`` field); it updates
+    the worker's EWMA, re-evaluates the fleet, and maintains the
+    ``ps.stragglers`` gauge.  Thread-safe — handler threads call it
+    concurrently.
+    """
+
+    def __init__(self, k: float = 3.0, alpha: float = 0.25,
+                 min_workers: int = 2, min_gap_s: float = 1e-3,
+                 registry: Optional[Registry] = None):
+        if k <= 1.0:
+            raise ValueError(f"straggler threshold k must exceed 1, got {k}")
+        self.k = float(k)
+        self.alpha = float(alpha)
+        #: a fleet of one has no peers to straggle behind
+        self.min_workers = int(min_workers)
+        #: median floor: below this the fleet is too fast for a multiple
+        #: of the median to mean anything (toy tests, cache-warm windows)
+        self.min_gap_s = float(min_gap_s)
+        self.registry = registry
+        self._lock = threading.Lock()
+        self._ewma: Dict[int, float] = {}
+        self._flagged: set = set()   # currently over threshold
+        self._log = get_logger("obs.stragglers")
+
+    def record(self, worker_id, gap_s) -> bool:
+        """Fold one heartbeat gap in; returns True iff ``worker_id`` is
+        currently flagged as a straggler."""
+        try:
+            w = int(worker_id)
+            gap = float(gap_s)
+        except (TypeError, ValueError):
+            return False
+        # gap_s arrives off the untrusted wire: one NaN would poison the
+        # EWMA forever (alpha·gap + (1−alpha)·NaN stays NaN) and a NaN
+        # member breaks every peer's median — reject non-finite outright
+        if not math.isfinite(gap) or gap < 0:
+            return False
+        with self._lock:
+            prev = self._ewma.get(w)
+            cur = gap if prev is None \
+                else self.alpha * gap + (1.0 - self.alpha) * prev
+            self._ewma[w] = cur
+            # rising-edge logging: one warn per INCIDENT — a worker that
+            # recovers and later straggles again crosses the edge again
+            prev_flagged = set(self._flagged)
+            flagged = self._reeval(updated=w)
+            newly = flagged - prev_flagged
+            ewma = dict(self._ewma)
+        for nw in sorted(newly):
+            peers = [v for p, v in ewma.items() if p != nw]
+            self._log.warning(
+                "straggler: worker %d heartbeat-gap EWMA %.3fs exceeds "
+                "%.1fx peer median %.3fs", nw, ewma[nw], self.k,
+                statistics.median(peers) if peers else 0.0)
+        return w in flagged
+
+    def _reeval(self, updated=None) -> set:  # caller holds self._lock
+        ewma = self._ewma
+        if len(ewma) >= self.min_workers:
+            # leave-one-out median: each worker is judged against its
+            # PEERS.  A self-inclusive median breaks down on small fleets
+            # — with 2 workers the straggler pulls the median halfway to
+            # itself and k=3 becomes mathematically unreachable.  This
+            # runs on the commit hot path under the detector lock, so the
+            # per-worker medians come from ONE shared sort (index math
+            # removes each worker's own value) — O(W log W) per commit,
+            # not O(W² log W).
+            vals = sorted(ewma.values())
+            flagged = set()
+            for w, e in ewma.items():
+                median = _loo_median(vals, bisect.bisect_left(vals, e))
+                if e > self.k * max(median, self.min_gap_s):
+                    flagged.add(w)
+            self._flagged = flagged
+        else:
+            self._flagged = set()
+        if self.registry is not None:
+            self.registry.gauge("ps.stragglers").set(len(self._flagged))
+            # only the recorded worker's EWMA moved; peers' gauges were
+            # set when THEY last recorded
+            targets = ewma if updated is None or updated not in ewma \
+                else {updated: ewma[updated]}
+            for w, e in targets.items():
+                self.registry.gauge(
+                    f"ps.heartbeat_gap_ewma.worker{w}").set(e)
+        return set(self._flagged)
+
+    @property
+    def stragglers(self) -> List[int]:
+        with self._lock:
+            return sorted(self._flagged)
+
+    def snapshot(self) -> dict:
+        """Plain-data state for the ``stats`` RPC reply / post-mortems.
+        ``peer_median_s`` is each worker's LEAVE-ONE-OUT peer median — the
+        same quantity the flag threshold multiplies, so the rendered
+        numbers always justify the flags shown next to them."""
+        with self._lock:
+            ewma = dict(self._ewma)
+            flagged = sorted(self._flagged)
+        return {"k": self.k, "alpha": self.alpha,
+                "min_gap_s": self.min_gap_s,
+                "gap_ewma_s": {str(w): ewma[w] for w in sorted(ewma)},
+                "peer_median_s": {
+                    str(w): statistics.median(
+                        [v for p, v in ewma.items() if p != w])
+                    if len(ewma) > 1 else 0.0
+                    for w in sorted(ewma)},
+                "stragglers": flagged}
+
+
+def detect_from_heartbeats(records, k: float = 3.0, alpha: float = 0.25,
+                           min_workers: int = 2,
+                           min_gap_s: float = 1e-3) -> dict:
+    """Replay a recorded heartbeat stream through the detector — the
+    offline half (``obsview`` run files).  ``records`` are JSONL dicts;
+    only ``event == "heartbeat"`` entries carrying ``gap_s`` count (old
+    streams without ``gap_s`` yield an empty fleet, never a crash)."""
+    det = StragglerDetector(k=k, alpha=alpha, min_workers=min_workers,
+                            min_gap_s=min_gap_s)
+    for r in records:
+        if r.get("event") != "heartbeat" or r.get("gap_s") is None:
+            continue
+        w = r.get("worker_id", r.get("worker"))
+        if w is not None:
+            det.record(w, r["gap_s"])
+    return det.snapshot()

@@ -16,7 +16,13 @@ layered on:
   workers' replace-style updates already do);
 * **delta codecs** — an optional ``ps.codecs`` codec compresses commit
   payloads (int8/bf16/top-k with worker-side error feedback); encode
-  latency and bytes saved land in this client's registry.
+  latency and bytes saved land in this client's registry;
+* **trace propagation** (ISSUE 5) — with a ``tracer``, pull/commit run
+  inside ``ps.pull``/``ps.commit`` spans and, on v2 connections, ship the
+  open span's ``(trace_id, parent_span)`` as a ``trace`` header so the
+  server's apply span links back to the worker window that caused it;
+  ``commit(gap_s=...)`` additionally carries the worker's heartbeat gap
+  for the server-side straggler detector.
 
 Instrumented (ISSUE 2): every RPC observes its round-trip latency into a
 ``ps.client.rtt_seconds`` histogram and reconnect events count under
@@ -31,11 +37,13 @@ owns that failure, as in the reference's Spark task retry).
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 from typing import Any, Optional
 
 from ..obs import TIME_BUCKETS, Registry, default_registry
+from ..obs.spans import SpanTracer
 from . import codecs
 from .networking import WIRE_VERSION, connect, recv_msg, send_msg
 
@@ -43,7 +51,8 @@ from .networking import WIRE_VERSION, connect, recv_msg, send_msg
 class PSClient:
     def __init__(self, host: str, port: int, worker_id: int = 0,
                  registry: Optional[Registry] = None,
-                 codec=None, wire_version: Optional[int] = None):
+                 codec=None, wire_version: Optional[int] = None,
+                 tracer: Optional[SpanTracer] = None):
         self.worker_id = int(worker_id)
         self.host = host
         self.port = port
@@ -59,6 +68,12 @@ class PSClient:
         #: delta codec (``ps.codecs``) — owned here because its
         #: error-feedback residual is per-worker state
         self.codec = codecs.get_codec(codec)
+        #: span tracer for cross-process trace propagation (ISSUE 5): when
+        #: set, pull/commit RPCs run inside ``ps.pull``/``ps.commit`` spans
+        #: and — on a v2 connection — ship ``(trace_id, parent_span)`` in a
+        #: ``trace`` header so the server's apply span links back here.
+        #: v1 peers simply never see the header (protocol untouched).
+        self.tracer = tracer
         #: ``None`` negotiates (the default); ``1`` pins the legacy wire —
         #: also reachable via ``DKTPU_WIRE=1`` for whole-process opt-out
         if wire_version is None and os.environ.get("DKTPU_WIRE") == "1":
@@ -122,46 +137,88 @@ class PSClient:
         self._h_rtt.observe(time.perf_counter() - t0)
         return resp
 
+    def _span(self, name: str):
+        """``ps.pull``/``ps.commit`` client span, or a no-op scope when no
+        tracer is attached (spans must never be a hard dependency)."""
+        if self.tracer is None:
+            return contextlib.nullcontext()
+        return self.tracer.span(name, worker=self.worker_id)
+
+    def _trace_header(self) -> Optional[dict]:
+        """``(trace_id, parent_span)`` of the currently-open client span —
+        the cross-process link the server's apply span adopts.  Only on v2
+        connections: the header is this build's protocol extension, and v1
+        is the frozen legacy surface old servers parse."""
+        if self.tracer is None or self.wire_version < 2:
+            return None
+        trace_id, span_id = self.tracer.context()
+        hdr = {"trace_id": trace_id}
+        if span_id is not None:
+            hdr["parent_span"] = span_id
+        return hdr
+
     def pull(self) -> tuple:
         """Returns ``(center_tree, server_update_counter)``.  Carries the
         counter of the center already held so an idle server answers
         ``unchanged`` instead of re-shipping megabytes (ISSUE 4)."""
-        msg = {"action": "pull", "worker_id": self.worker_id}
-        if self._last_pull is not None:
-            msg["have"] = self._last_pull[1]
-        resp = self._rpc(msg, retry=True)
-        updates = int(resp["updates"])
-        if resp.get("unchanged"):
-            if self._last_pull is not None:
-                self._c_unchanged.inc()
-                return self._last_pull[0], updates
-            # the cache was invalidated mid-RPC (a transparent reconnect
-            # dropped it, but the retry resent the stale ``have``): ask
-            # again unconditionally for the full center
-            resp = self._rpc({"action": "pull",
-                              "worker_id": self.worker_id}, retry=True)
-            updates = int(resp["updates"])
-        self._last_pull = (resp["center"], updates)
-        return resp["center"], updates
+        with self._span("ps.pull"):
+            def pull_msg(have=None) -> dict:
+                # one assembly point so protocol keys (like the trace
+                # header) can never be added to one request shape and
+                # missed on the other
+                msg = {"action": "pull", "worker_id": self.worker_id}
+                trace = self._trace_header()
+                if trace is not None:
+                    msg["trace"] = trace
+                if have is not None:
+                    msg["have"] = have
+                return msg
 
-    def commit(self, delta: Any, last_update: Optional[int] = None) -> bool:
+            have = self._last_pull[1] if self._last_pull is not None \
+                else None
+            resp = self._rpc(pull_msg(have), retry=True)
+            updates = int(resp["updates"])
+            if resp.get("unchanged"):
+                if self._last_pull is not None:
+                    self._c_unchanged.inc()
+                    return self._last_pull[0], updates
+                # the cache was invalidated mid-RPC (a transparent
+                # reconnect dropped it, but the retry resent the stale
+                # ``have``): ask again unconditionally for the full center
+                resp = self._rpc(pull_msg(), retry=True)
+                updates = int(resp["updates"])
+            self._last_pull = (resp["center"], updates)
+            return resp["center"], updates
+
+    def commit(self, delta: Any, last_update: Optional[int] = None,
+               gap_s: Optional[float] = None) -> bool:
         """Commit a delta; returns False if a fault injector dropped it.
         A non-identity codec compresses the payload here (error-feedback
         residual updated as a side effect) — the server decodes
-        statelessly from the per-leaf stubs."""
-        if not self.codec.is_identity:
-            t0 = time.perf_counter()
-            raw = codecs.tree_payload_bytes(delta)
-            delta = self.codec.encode(delta)
-            codecs.count_codec_bytes(self.registry, raw,
-                                     codecs.tree_payload_bytes(delta))
-            self._h_encode.observe(time.perf_counter() - t0)
-        msg = {"action": "commit", "worker_id": self.worker_id,
-               "delta": delta, "codec": self.codec.name}
-        if last_update is not None:
-            msg["last_update"] = int(last_update)
-        resp = self._rpc(msg)
-        return not resp.get("dropped", False)
+        statelessly from the per-leaf stubs.
+
+        ``gap_s`` is the worker's monotonic gap since its previous window
+        commit — the heartbeat signal the server-side straggler detector
+        folds in (ISSUE 5); harmless extra key to old servers."""
+        with self._span("ps.commit"):
+            if not self.codec.is_identity:
+                t0 = time.perf_counter()
+                raw = codecs.tree_payload_bytes(delta)
+                delta = self.codec.encode(delta)
+                codecs.count_codec_bytes(self.registry, raw,
+                                         codecs.tree_payload_bytes(delta))
+                self._h_encode.observe(time.perf_counter() - t0)
+            msg = {"action": "commit", "worker_id": self.worker_id,
+                   "delta": delta, "codec": self.codec.name}
+            trace = self._trace_header()
+            if trace is not None:
+                msg["trace"] = trace
+            if gap_s is not None:
+                msg["gap_s"] = float(gap_s)
+            if last_update is not None:
+                msg["last_update"] = int(last_update)
+            resp = self._rpc(msg)
+            return not resp.get("dropped", False)
 
     def stats(self) -> dict:
         """Poll the server's live telemetry: ``{"stats": <registry

@@ -22,6 +22,17 @@ byte can never open a v1 length prefix below 4.9 EB), so a server accepts
 both; which format a peer may *send to you* is negotiated once per
 connection by the PS hello handshake (``ps.client`` / ``ps.servers``).
 
+The framing is payload-agnostic; protocol extensions ride as extra keys
+in the msgpack map, never as frame changes — unknown keys are ignored by
+every parser of this wire, so extensions degrade cleanly against old
+peers.  ISSUE 5 adds two: a ``trace`` header (``trace_id``/``parent_span``
+— cross-process span linkage) that clients send only on v2 connections
+(adoption needs both ends current, so v1 peers never see it), and
+``gap_s`` (the worker's heartbeat gap, feeding the server's straggler
+detector) which rides EVERY commit regardless of wire version — straggler
+visibility matters most for the legacy-pinned fleets most likely to
+contain one; old servers ignore it.
+
 Instrumented (ISSUE 2): every framed send/recv counts messages and wire
 bytes (frame header included) into an ``obs.Registry`` — the component's
 own when the caller passes one (the PS server's ``STATS`` snapshot counts

@@ -26,6 +26,7 @@ import time
 import jax
 import numpy as np
 
+from ..obs.spans import SpanTracer
 from ..parallel.sync import make_window_fn
 from ..utils import serde
 from .servers import SocketParameterServer
@@ -106,7 +107,14 @@ def run_async_training(trainer, dataset, fault_injector=None,
             start_windows = [ps.commits_by_worker.get(k, 0)
                              for k in range(trainer.num_workers)]
             center = ps.get_model()  # workers start from the restored center
-    server = SocketParameterServer(ps, fault_injector=fault_injector).start()
+    # server-side tracer shares the trainer's JSONL sink: every commit's
+    # ``ps.apply`` span adopts the committing worker's trace context, so
+    # the stream links server applies to the worker windows that caused
+    # them (obsview's cross-process timeline, ISSUE 5); span durations
+    # also land in the PS registry (``span.ps.apply.seconds``)
+    server = SocketParameterServer(
+        ps, fault_injector=fault_injector,
+        tracer=SpanTracer(trainer.metrics, registry=ps.registry)).start()
     t_run0 = time.time()  # heartbeats at/after this instant belong to THIS run
 
     try:

@@ -23,6 +23,7 @@ snapshot plus ground-truth counters — a running PS is pollable live
 from __future__ import annotations
 
 import collections
+import contextlib
 import socket
 import threading
 import time
@@ -30,7 +31,8 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
-from ..obs import COUNT_BUCKETS, TIME_BUCKETS, Registry
+from ..obs import COUNT_BUCKETS, TIME_BUCKETS, Registry, StragglerDetector
+from ..obs.spans import SpanTracer
 from ..parallel.sync import _inexact, tmap as _tree_map
 from ..utils import native
 from . import codecs
@@ -233,12 +235,20 @@ class SocketParameterServer:
     encoded once per commit, not once per pull (safe because commits
     replace, never mutate, the center arrays the cached v2 frames
     reference); ``commit`` decodes ``ps.codecs`` deltas statelessly.
+
+    ISSUE 5 observability: commits carrying a ``trace`` header get their
+    ``ps.apply`` span parented on the committing worker's span (the
+    cross-process timeline); commits carrying ``gap_s`` feed the
+    heartbeat-gap straggler detector, whose ``ps.stragglers`` gauge and
+    snapshot ride the ``stats`` reply.
     """
 
     def __init__(self, ps: ParameterServer, host: str = "127.0.0.1",
                  port: int = 0,
                  fault_injector: Optional[Callable[[str, dict], bool]] = None,
-                 max_wire_version: int = WIRE_VERSION):
+                 max_wire_version: int = WIRE_VERSION,
+                 tracer: Optional[SpanTracer] = None,
+                 straggler_detector: Optional[StragglerDetector] = None):
         self.ps = ps
         self.host = host
         self.port = port
@@ -246,6 +256,17 @@ class SocketParameterServer:
         #: newest frame format this server will negotiate; pin to 1 to
         #: emulate (and interop-test against) a legacy v1-only server
         self.max_wire_version = int(max_wire_version)
+        #: server-side span tracer (ISSUE 5): when set, every commit apply
+        #: runs inside a ``ps.apply`` span that ADOPTS the trace context a
+        #: v2 client shipped in the request (``trace_id``/``parent_span``)
+        #: — the cross-process link obsview's timeline renders.  None keeps
+        #: the handler span-free (no sink, no overhead).
+        self.tracer = tracer
+        #: heartbeat-gap straggler detector fed from the commit RPC's
+        #: ``gap_s`` field; publishes the ``ps.stragglers`` gauge into the
+        #: PS registry so the live ``stats`` RPC carries it
+        self.stragglers = straggler_detector if straggler_detector \
+            is not None else StragglerDetector(registry=ps.registry)
         self._sock: Optional[socket.socket] = None
         self._threads: list = []
         self._conns: list = []
@@ -349,6 +370,25 @@ class SocketParameterServer:
                 self._pull_cache[ver] = (updates, payload)
         return payload
 
+    def _remote_span(self, name: str, msg: dict):
+        """Server-side span adopting the requester's trace context (the
+        ``trace`` header a v2 client ships on commit/pull).  No tracer —
+        or an untraced request on ``serve_pull`` — means no span at all:
+        v1 peers and span-free servers pay nothing."""
+        if self.tracer is None:
+            return contextlib.nullcontext()
+        trace = msg.get("trace")
+        if not isinstance(trace, dict):
+            if name != "ps.apply":
+                return contextlib.nullcontext()
+            trace = {}
+        fields = {"worker": msg.get("worker_id")}
+        if trace.get("trace_id") is not None:
+            fields["trace_id"] = trace["trace_id"]
+        if trace.get("parent_span") is not None:
+            fields["parent_span"] = trace["parent_span"]
+        return self.tracer.span(name, **fields)
+
     def _decoded_delta(self, msg: dict):
         """Commit delta, codec stubs decoded (latency + bytes observed)."""
         delta = msg.get("delta")
@@ -384,31 +424,41 @@ class SocketParameterServer:
                         send_msg(conn, {"ok": True, "version": ver},
                                  registry=reg)
                     elif action == "pull":
-                        have = msg.get("have")
-                        center, updates = self.ps.pull()
-                        if have is not None and int(have) == updates:
-                            self._c_unchanged.inc()
-                            send_msg(conn, {"unchanged": True,
-                                            "updates": updates},
-                                     registry=reg, version=ver)
-                        else:
-                            send_packed(conn,
-                                        self._center_payload(center, updates,
-                                                             ver),
-                                        registry=reg)
+                        with self._remote_span("ps.serve_pull", msg):
+                            have = msg.get("have")
+                            center, updates = self.ps.pull()
+                            if have is not None and int(have) == updates:
+                                self._c_unchanged.inc()
+                                send_msg(conn, {"unchanged": True,
+                                                "updates": updates},
+                                         registry=reg, version=ver)
+                            else:
+                                send_packed(conn,
+                                            self._center_payload(
+                                                center, updates, ver),
+                                            registry=reg)
                     elif action == "commit":
+                        # liveness first: a dropped commit is still a
+                        # heartbeat — the fault injector models a lost
+                        # UPDATE, not a dead worker
+                        if msg.get("gap_s") is not None:
+                            self.stragglers.record(msg.get("worker_id"),
+                                                   msg.get("gap_s"))
                         dropped = bool(
                             self.fault_injector and
                             self.fault_injector("commit", msg))
                         if not dropped:
-                            self.ps.handle_commit(self._decoded_delta(msg),
-                                                  msg)
+                            delta = self._decoded_delta(msg)
+                            with self._remote_span("ps.apply", msg):
+                                self.ps.handle_commit(delta, msg)
                         else:
                             self._c_dropped.inc()
                         send_msg(conn, {"ok": True, "dropped": dropped},
                                  registry=reg, version=ver)
                     elif action == "stats":
-                        send_msg(conn, self.ps.stats(), registry=reg,
+                        reply = self.ps.stats()
+                        reply["stragglers"] = self.stragglers.snapshot()
+                        send_msg(conn, reply, registry=reg,
                                  version=ver)
                     elif action == "stop":
                         send_msg(conn, {"ok": True}, registry=reg,

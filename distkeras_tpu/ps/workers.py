@@ -22,11 +22,13 @@ staleness.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
 
+from ..obs.spans import SpanTracer
 from ..parallel.sync import _inexact, adopt_float_leaves, tmap as _tmap
 from .client import PSClient
 
@@ -79,6 +81,15 @@ class AsyncWorker(threading.Thread):
         self.window_losses: list = []
         self.error: Optional[BaseException] = None
         self.xs = self.ys = None        # (n_windows, w, batch, ...) numpy
+        #: per-worker span tracer (built on the worker's own thread in
+        #: ``run()``): trace id ``w<worker_id>``, sink shared with the
+        #: heartbeats — commit/pull spans and the server's linked apply
+        #: spans interleave in one stream (ISSUE 5)
+        self.tracer: Optional[SpanTracer] = None
+        #: monotonic clock of the previous commit — the heartbeat-gap
+        #: source (``gap_s``); wall-clock diffs would absorb NTP steps
+        self._last_commit_mono: Optional[float] = None
+        self._gap_s: Optional[float] = None
 
     def set_data(self, xs, ys):
         self.xs, self.ys = xs, ys
@@ -98,14 +109,31 @@ class AsyncWorker(threading.Thread):
 
     def run(self):
         try:
+            # built HERE so the thread-local trace id binds to the worker's
+            # own thread (__init__ runs on the spawning thread)
+            self.tracer = SpanTracer(self.metrics)
+            self.tracer.set_trace_id(f"w{self.worker_id}")
+            self._last_commit_mono = time.monotonic()
             client = PSClient(self.ps_host, self.ps_port, self.worker_id,
-                              codec=self.comm_codec)
+                              codec=self.comm_codec, tracer=self.tracer)
             try:
                 self._train(client)
             finally:
                 client.close()
         except BaseException as e:  # surfaced by the runner after join()
             self.error = e
+
+    def _commit_gap(self) -> float:
+        """Monotonic seconds since this worker's previous commit — the
+        per-window heartbeat gap shipped on the commit RPC (and echoed on
+        the heartbeat record) so the straggler detector and obsview never
+        reconstruct gaps from wall-clock diffs (ISSUE 5).  The first
+        window measures from loop start: a worker that stalls before its
+        first commit still shows a stretched gap."""
+        now = time.monotonic()
+        self._gap_s = now - self._last_commit_mono
+        self._last_commit_mono = now
+        return self._gap_s
 
     def _train(self, client: PSClient):
         stream = getattr(self, "_stream_factory", None)
@@ -164,12 +192,16 @@ class AsyncWorker(threading.Thread):
     def _heartbeat(self, gw: int, n_windows: int) -> None:
         """One liveness record per committed window into the shared sink.
         The latest window's mean loss rides along so a live tail of the
-        JSONL shows progress AND health per worker."""
+        JSONL shows progress AND health per worker; ``worker_id`` +
+        monotonic ``gap_s`` make each record self-contained for the
+        straggler detector and obsview (ISSUE 5 — no wall-clock-diff
+        reconstruction downstream; readers fall back to the pre-PR-5
+        ``worker`` key on old streams)."""
         if self.metrics is None:
             return
         _, losses = self.window_losses[-1]
-        self.metrics.log("heartbeat", worker=self.worker_id, window=gw,
-                         epoch=gw // n_windows,
+        self.metrics.log("heartbeat", worker_id=self.worker_id, window=gw,
+                         epoch=gw // n_windows, gap_s=self._gap_s,
                          mean_loss=float(np.mean(losses)))
 
     def _run_window(self, wx, wy):
@@ -192,7 +224,7 @@ class PullCommitWorker(AsyncWorker):
         losses = self._run_window(wx, wy)
         after = _host(self.variables)
         delta = _tmap(lambda a, c: a - np.asarray(c), after, center)
-        client.commit(delta)
+        client.commit(delta, gap_s=self._commit_gap())
         return losses
 
 
@@ -206,7 +238,8 @@ class StalenessWorker(AsyncWorker):
         losses = self._run_window(wx, wy)
         after = _host(self.variables)
         delta = _tmap(lambda a, c: a - np.asarray(c), after, center)
-        client.commit(delta, last_update=seen_updates)
+        client.commit(delta, last_update=seen_updates,
+                      gap_s=self._commit_gap())
         return losses
 
 
@@ -230,5 +263,5 @@ class ElasticWorker(AsyncWorker):
             else np.zeros_like(l), local, center)
         self.variables = self._put(
             _tmap(lambda l, e: l - e, local, elastic))
-        client.commit(elastic)
+        client.commit(elastic, gap_s=self._commit_gap())
         return losses

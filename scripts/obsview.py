@@ -1,21 +1,29 @@
 """Run-inspection CLI for the telemetry stream (ISSUE 2).
 
-Two modes:
+Three modes:
 
 * ``python scripts/obsview.py RUN.jsonl`` — read a JSONL metrics file (the
   ``MetricsLogger`` sink a trainer wrote: epoch records, spans, async
   heartbeats, the final ``ps_stats`` registry snapshot) and print a run
   summary: per-epoch table, throughput timeline, staleness distribution,
-  top spans by cumulative time, per-worker heartbeat coverage.
+  straggler analysis over the heartbeat gaps, the per-worker cross-process
+  timeline (worker commit spans linked to the server apply spans they
+  caused — ISSUE 5 trace propagation), top spans by cumulative time,
+  per-worker heartbeat coverage.
 * ``python scripts/obsview.py --ps HOST:PORT`` — poll a LIVE
   ``SocketParameterServer`` via its ``stats`` RPC and print the registry
-  snapshot (``--prometheus`` renders Prometheus text instead — pipe it
-  anywhere that scrapes the standard format).
+  snapshot + straggler state (``--prometheus`` renders Prometheus text
+  instead — pipe it anywhere that scrapes the standard format).
+* ``python scripts/obsview.py --diff BASE CAND`` — drift-gate two
+  persisted registry-snapshot files (``obs.drift``): counter ratio deltas,
+  bucket-wise PSI + p50/p99 shift per histogram, thresholds from the
+  committed ``OBS_BASELINE.json`` (or ``--thresholds FILE``).
+  CI-friendly exit codes: 0 clean, 1 drift detected, 2 usage error.
 
 The file mode also accepts a persisted registry-snapshot JSON (the
-``BENCH_PS_OBS.json`` that ``bench.py --ps`` writes beside BENCH_r*.json):
-per-registry instrument tables plus the commit-codec accounting
-(compression ratio, bytes saved — ISSUE 4).
+``BENCH_PS_OBS.json`` / ``BENCH_TRAINER_OBS.json`` that ``bench.py``
+writes beside BENCH_r*.json): per-registry instrument tables plus the
+commit-codec accounting (compression ratio, bytes saved — ISSUE 4).
 
 Everything renders through pure functions over plain records
 (``summarize`` / ``summarize_stats``) so tests — and notebooks — can call
@@ -35,7 +43,8 @@ if ROOT not in sys.path:  # runnable as a script from anywhere
     sys.path.insert(0, ROOT)
 
 from distkeras_tpu.obs import (  # noqa: E402
-    emit, snapshot_quantile, to_prometheus_text)
+    detect_from_heartbeats, emit, snapshot_quantile, to_prometheus_text)
+from distkeras_tpu.obs import drift  # noqa: E402
 
 _BLOCKS = " ▁▂▃▄▅▆▇█"
 
@@ -107,6 +116,17 @@ def _sparkline(values) -> str:
     return "".join(_BLOCKS[min(8, int(round(v / hi * 8)))] for v in vals)
 
 
+def _median(sorted_vals: list) -> float:
+    """True median of a pre-sorted list (even length averages the middle
+    pair — the upper-element shortcut overstates small samples)."""
+    n = len(sorted_vals)
+    if not n:
+        return 0.0
+    if n % 2:
+        return sorted_vals[n // 2]
+    return (sorted_vals[n // 2 - 1] + sorted_vals[n // 2]) / 2.0
+
+
 def _fmt_seconds(s: float) -> str:
     if s >= 1.0:
         return f"{s:.2f}s"
@@ -172,6 +192,74 @@ def _codec_lines(stats: dict) -> list:
     return lines
 
 
+def _timeline_lines(spans: list) -> list:
+    """Per-worker cross-process timeline (ISSUE 5): worker ``ps.commit``
+    spans matched to the server ``ps.apply`` spans that adopted their
+    span id as ``parent_span`` — the trace the PS wire carried."""
+    commits = [s for s in spans if s.get("name") == "ps.commit"]
+    applies = [s for s in spans if s.get("name") == "ps.apply"]
+    if not commits and not applies:
+        return []
+    apply_by_parent = {a["parent_span"]: a for a in applies
+                       if a.get("parent_span") is not None}
+    lines = ["== Cross-process timeline (per worker) ==",
+             f"{'worker':>6}  {'trace':<10} {'commits':>8}  "
+             f"{'applies':>8}  {'commit p50':>10}  {'apply p50':>10}  "
+             "commit seconds"]
+    by_trace: dict = {}
+    for c in commits:
+        by_trace.setdefault(c.get("trace_id", "?"), []).append(c)
+    linked_total = 0
+    for trace in sorted(by_trace):
+        group = sorted(by_trace[trace], key=lambda s: _num(s.get("ts"), 0.0))
+        linked = [apply_by_parent[c["span_id"]] for c in group
+                  if c.get("span_id") in apply_by_parent]
+        linked_total += len(linked)
+        secs = sorted(_num(c.get("seconds"), 0.0) for c in group)
+        apply_secs = sorted(_num(a.get("seconds"), 0.0) for a in linked)
+        p50 = _median(secs)
+        a50 = _median(apply_secs)
+        lines.append(
+            f"{group[0].get('worker', '?'):>6}  {trace:<10} "
+            f"{len(group):>8}  {len(linked):>8}  {_fmt_seconds(p50):>10}  "
+            f"{(_fmt_seconds(a50) if apply_secs else '-'):>10}  "
+            f"[{_sparkline([_num(c.get('seconds'), 0.0) for c in group])}]")
+    orphans = len(applies) - linked_total
+    if orphans > 0:
+        lines.append(f"({orphans} apply span(s) without a linked commit "
+                     "span — v1 peers or spans outside this stream)")
+    return lines
+
+
+def _straggler_lines(snap: dict, source: str) -> list:
+    """Straggler state — live (``stats`` RPC reply) or replayed from the
+    recorded heartbeat gaps (``obs.stragglers.detect_from_heartbeats``)."""
+    ewma = (snap or {}).get("gap_ewma_s") or {}
+    if not ewma:
+        return []
+    def _wkey(w):  # numeric-aware: '10' sorts after '2', not before
+        try:
+            return (0, int(w))
+        except (TypeError, ValueError):
+            return (1, str(w))
+
+    flagged = set(str(w) for w in snap.get("stragglers", []))
+    peer = snap.get("peer_median_s") or {}
+    floor = _num(snap.get("min_gap_s"), 0.0)
+    lines = [f"== Stragglers ({source}) ==",
+             f"threshold: {snap.get('k', '?')}x leave-one-out peer median"
+             + (f" (floored at {_fmt_seconds(floor)})" if floor else "")
+             + "   flagged: "
+             + (str(sorted(flagged, key=_wkey)) if flagged else "none")]
+    for w in sorted(ewma, key=lambda k: -_num(ewma[k], 0.0)):
+        mark = "  << STRAGGLER" if w in flagged else ""
+        pm = _num(peer.get(w), 0.0)
+        lines.append(f"  worker {w:>3}  gap EWMA "
+                     f"{_fmt_seconds(_num(ewma[w], 0.0)):>8}  "
+                     f"(peers {_fmt_seconds(pm)}){mark}")
+    return lines
+
+
 def _top_spans(spans: list, top: int = 10) -> list:
     lines = ["== Top spans by cumulative time ==",
              f"{'span':<24} {'count':>6}  {'total':>10}  {'mean':>10}"]
@@ -189,7 +277,7 @@ def _top_spans(spans: list, top: int = 10) -> list:
 def _heartbeat_lines(heartbeats: list) -> list:
     by_worker: dict = {}
     for h in heartbeats:
-        w = h.get("worker", "?")
+        w = h.get("worker_id", h.get("worker", "?"))
         cur = by_worker.setdefault(w, {"n": 0, "last_window": -1,
                                        "last_ts": 0.0})
         cur["n"] += 1
@@ -259,7 +347,13 @@ def summarize(records: list) -> str:
                     f"{_fmt_seconds(snapshot_quantile(h, 0.99))}")
         sections.append(lines)
         sections.append(_codec_lines(stats))
+    if heartbeats:
+        # replay the recorded gaps through the same detector the live PS
+        # runs — post-mortem straggler analysis (ISSUE 5)
+        sections.append(_straggler_lines(
+            detect_from_heartbeats(records), "replayed from heartbeats"))
     if spans:
+        sections.append(_timeline_lines(spans))
         sections.append(_top_spans(spans))
     if heartbeats:
         sections.append(_heartbeat_lines(heartbeats))
@@ -286,9 +380,9 @@ def _instrument_lines(stats: dict) -> list:
     return lines
 
 
-def _is_registry_snapshot(d) -> bool:
-    return isinstance(d, dict) and bool(d) and all(
-        isinstance(v, dict) and "type" in v for v in d.values())
+#: registry-snapshot detection shared with the drift gate (obs.drift owns
+#: the definition; the alias keeps this module's call sites readable)
+_is_registry_snapshot = drift.is_registry_snapshot
 
 
 def summarize_snapshot(doc: dict) -> str:
@@ -321,6 +415,10 @@ def summarize_stats(reply: dict) -> str:
     if codec:
         lines.append("")
         lines.extend(codec)
+    stragglers = _straggler_lines(reply.get("stragglers") or {}, "live")
+    if stragglers:
+        lines.append("")
+        lines.extend(stragglers)
     if "ps.staleness" in stats:
         lines.append("")
         lines.extend(_staleness_lines(stats["ps.staleness"]))
@@ -333,20 +431,69 @@ def poll_stats(host: str, port: int) -> dict:
         return client.stats()
 
 
+def run_diff(base: str, cand: str, thresholds=None) -> int:
+    """``--diff`` body: drift-gate two snapshot files.  Exit codes are the
+    CI contract — 0 clean, 1 drift, 2 unreadable/invalid input."""
+    try:
+        if thresholds:
+            # an EXPLICITLY named config failing to parse is a usage error
+            baseline = drift.load_baseline(thresholds)
+        else:
+            found = drift.find_baseline(
+                os.path.dirname(os.path.abspath(base))) \
+                or drift.find_baseline(ROOT)
+            baseline = None
+            if found:
+                try:
+                    baseline = drift.load_baseline(found)
+                except (OSError, ValueError) as e:
+                    # auto-discovered config: degrade to defaults with a
+                    # note (same policy as bench.py) — an unrelated bad
+                    # file must not fail every diff of valid snapshots
+                    emit(f"obsview --diff: ignoring invalid {found} "
+                         f"({e}); using default thresholds", err=True)
+        report = drift.diff_files(base, cand, baseline=baseline)
+    except (OSError, ValueError) as e:
+        emit(f"obsview --diff: {e}", err=True)
+        return 2
+    emit(report.render())
+    if all(f.get("skipped") for f in report.findings):
+        # disjoint registries, wrong file pairing, or everything skipped
+        # (gauges / too-thin histograms): a gate that COMPARED nothing
+        # must not report green — exit-0 is reserved for "compared and
+        # clean"
+        emit("obsview --diff: no comparable metrics between the two "
+             "snapshots (wrong file pairing?)", err=True)
+        return 2
+    return 1 if report.drifted else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="inspect a telemetry JSONL file or poll a live PS")
+        description="inspect a telemetry JSONL file, poll a live PS, or "
+                    "drift-gate two registry snapshots")
     ap.add_argument("jsonl", nargs="?",
                     help="JSONL metrics file written by MetricsLogger")
     ap.add_argument("--ps", metavar="HOST:PORT",
                     help="poll a live SocketParameterServer's stats RPC")
+    ap.add_argument("--diff", nargs=2, metavar=("BASE", "CAND"),
+                    help="compare two registry-snapshot files for "
+                         "distribution drift (exit 0 clean / 1 drift / "
+                         "2 error)")
+    ap.add_argument("--thresholds", metavar="OBS_BASELINE",
+                    help="with --diff: threshold config file (default: "
+                         "the committed OBS_BASELINE.json, discovered "
+                         "upward from BASE, then from the repo root)")
     ap.add_argument("--prometheus", action="store_true",
                     help="with --ps (or a ps_stats record): render the "
                          "registry snapshot as Prometheus text")
     args = ap.parse_args(argv)
 
-    if bool(args.jsonl) == bool(args.ps):
-        ap.error("need exactly one of JSONL or --ps")
+    if sum(map(bool, (args.jsonl, args.ps, args.diff))) != 1:
+        ap.error("need exactly one of JSONL, --ps or --diff")
+
+    if args.diff:
+        return run_diff(args.diff[0], args.diff[1], args.thresholds)
 
     if args.ps:
         host, _, port = args.ps.rpartition(":")

@@ -499,16 +499,31 @@ def test_racecheck_survives_restore_rebind(tmp_path):
         assert len(violations) == 1
 
 
-@pytest.mark.skipif(bool(os.environ.get(racecheck.ENV_VAR)),
-                    reason="conftest fixture keeps racecheck installed "
-                           "for the whole test under DKLINT_RACECHECK")
 def test_racecheck_uninstall_restores_plain_ps():
-    from distkeras_tpu.ps.servers import DeltaParameterServer
-    with racecheck.enabled():
-        pass
-    ps = DeltaParameterServer(_tree([0.0]))
-    assert not isinstance(ps.mutex, racecheck.TrackedLock)
-    assert type(ps.commits_by_worker) is dict
+    """``enabled()`` exit must restore the plain ParameterServer.  Run in
+    a subprocess with racecheck opted OUT: under the tier-1 default the
+    autouse conftest fixture keeps racecheck installed around every test
+    in THIS process, which would mask an uninstall regression (a skipif
+    here would simply never run the check in any default leg)."""
+    import subprocess
+    import sys
+    code = (
+        "import numpy as np\n"
+        "from distkeras_tpu.analysis import racecheck\n"
+        "from distkeras_tpu.ps.servers import DeltaParameterServer\n"
+        "tree = {'params': [{'w': np.zeros(1, np.float32)}], 'state': [{}]}\n"
+        "with racecheck.enabled():\n"
+        "    assert racecheck.installed()\n"
+        "assert not racecheck.installed()\n"
+        "ps = DeltaParameterServer(tree)\n"
+        "assert not isinstance(ps.mutex, racecheck.TrackedLock)\n"
+        "assert type(ps.commits_by_worker) is dict\n"
+        "print('UNINSTALL_OK')\n")
+    env = {**os.environ, "DKLINT_RACECHECK": "0", "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "UNINSTALL_OK" in out.stdout
 
 
 # ---------------------------------------------------------------------------

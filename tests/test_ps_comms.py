@@ -402,6 +402,72 @@ def test_bench_ps_emits_row_and_snapshot(tmp_path):
     assert doc["server"]["ps.commits"]["value"] == 4
 
 
+def test_bench_ps_contention_sweep_merges_snapshots(tmp_path):
+    """--ps-workers sweep point (ISSUE 5 satellite): N concurrent clients,
+    ONE merged client registry snapshot per point, named per point."""
+    sys.path.insert(0, ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(ROOT)
+    row = bench.bench_ps(codec="none", windows=3, mb=0.1,
+                         out_dir=str(tmp_path), ps_workers=2)
+    assert row["ps_workers"] == 2
+    snap_file = tmp_path / "BENCH_PS_OBS_w2.json"
+    assert snap_file.exists()
+    doc = json.loads(snap_file.read_text())
+    assert doc["config"]["ps_workers"] == 2
+    # merged across both clients: every client committed `windows` times,
+    # and every RPC (1 warm pull + 3x(pull+commit) each) observed an RTT
+    assert doc["server"]["ps.commits"]["value"] == 2 * 3
+    assert doc["client"]["ps.client.rtt_seconds"]["count"] == 2 * (1 + 2 * 3)
+    # obsview's snapshot-file mode reads the sweep point unchanged
+    sys.path.insert(0, os.path.join(ROOT, "scripts"))
+    try:
+        import obsview
+    finally:
+        sys.path.remove(os.path.join(ROOT, "scripts"))
+    out = obsview.summarize_snapshot(obsview.load_snapshot(str(snap_file)))
+    assert "client registry" in out and "server registry" in out
+
+
+def test_bench_ps_self_check_against_committed_baseline(tmp_path):
+    """The single-worker bench drift-checks against the committed
+    BENCH_PS_OBS.json (ISSUE 5): matching config -> checked; the config
+    recorded in the committed snapshot names the committed run."""
+    sys.path.insert(0, ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(ROOT)
+    with open(os.path.join(ROOT, "BENCH_PS_OBS.json")) as f:
+        committed_cfg = json.load(f)["config"]
+    # a config that cannot match the committed one -> skipped, with reason
+    row = bench.bench_ps(codec="none", windows=2, mb=0.05,
+                         out_dir=str(tmp_path))
+    assert row["obs_drift"]["checked"] is False
+    assert "config" in row["obs_drift"]["reason"]
+    assert committed_cfg["ps_workers"] == 1  # committed baseline shape
+    first = json.loads((tmp_path / "BENCH_PS_OBS.json").read_text())
+    # a config-incompatible rerun diverts to a .variant sidecar instead of
+    # clobbering the baseline snapshot in place
+    row2 = bench.bench_ps(codec="none", windows=3, mb=0.05,
+                          out_dir=str(tmp_path))
+    assert row2["snapshot"].endswith("BENCH_PS_OBS.variant.json")
+    assert (tmp_path / "BENCH_PS_OBS.variant.json").exists()
+    assert json.loads((tmp_path / "BENCH_PS_OBS.json").read_text()) == first
+    # a same-config rerun refreshes in place and the self-check engages
+    row3 = bench.bench_ps(codec="none", windows=2, mb=0.05,
+                          out_dir=str(tmp_path))
+    assert row3["snapshot"].endswith("BENCH_PS_OBS.json")
+    # a CORRUPT destination snapshot is never overwritten in place
+    (tmp_path / "BENCH_PS_OBS.json").write_text("{garbled")
+    row4 = bench.bench_ps(codec="none", windows=2, mb=0.05,
+                          out_dir=str(tmp_path))
+    assert row4["snapshot"].endswith("BENCH_PS_OBS.variant.json")
+    assert (tmp_path / "BENCH_PS_OBS.json").read_text() == "{garbled"
+
+
 def test_obsview_prints_codec_accounting(tmp_path):
     sys.path.insert(0, os.path.join(ROOT, "scripts"))
     try:

@@ -1,0 +1,351 @@
+"""PS wire trace propagation + async straggler detection (ISSUE 5).
+
+Covers the span identity layer (trace/span ids, parent links), the wire
+header end to end over real sockets (v2 carries it, v1 peers interop with
+it absent), the heartbeat-gap straggler detector (EWMA math, leave-one-out
+median flagging, one-time warn, live ``stats`` exposure), and the
+acceptance scenario: a threaded async run with one artificially delayed
+worker shows ``ps.stragglers >= 1`` in the live ``stats`` RPC and an
+obsview timeline linking a server apply span to that worker's trace id."""
+
+import importlib.util
+import io
+import json
+import logging
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.obs import Registry, SpanTracer, StragglerDetector
+from distkeras_tpu.obs.stragglers import detect_from_heartbeats
+from distkeras_tpu.ps import (DeltaParameterServer, PSClient,
+                              SocketParameterServer)
+from distkeras_tpu.ps.workers import PullCommitWorker
+from distkeras_tpu.utils.metrics import MetricsLogger
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_obsview():
+    spec = importlib.util.spec_from_file_location(
+        "obsview", os.path.join(_ROOT, "scripts", "obsview.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+obsview = _load_obsview()
+
+
+def tree(v):
+    return {"params": [{"w": np.asarray(v, dtype=np.float32)}], "state": [{}]}
+
+
+def _records(buf):
+    return [json.loads(l) for l in buf.getvalue().splitlines()]
+
+
+def _spans(buf, name=None):
+    spans = [r for r in _records(buf) if r["event"] == "span"]
+    return [s for s in spans if name is None or s["name"] == name]
+
+
+# -- span identity -----------------------------------------------------------
+
+def test_span_ids_and_parent_links():
+    buf = io.StringIO()
+    tracer = SpanTracer(MetricsLogger(buf))
+    tracer.set_trace_id("w7")
+    assert tracer.context() == ("w7", None)
+    with tracer.span("outer"):
+        outer_id = tracer.current_span_id()
+        assert tracer.context() == ("w7", outer_id)
+        with tracer.span("inner"):
+            assert tracer.current_span_id() != outer_id
+    inner, outer = _spans(buf)
+    assert outer["trace_id"] == inner["trace_id"] == "w7"
+    assert inner["parent_span"] == outer["span_id"]
+    assert "parent_span" not in outer
+    assert outer["span_id"] != inner["span_id"]
+
+
+def test_trace_id_thread_local_and_lazy():
+    tracer = SpanTracer(None)
+    seen = {}
+
+    def grab(k):
+        seen[k] = tracer.trace_id()
+    ts = [threading.Thread(target=grab, args=(k,)) for k in range(2)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert seen[0] != seen[1]  # lazily minted per thread, distinct
+
+
+def test_explicit_trace_fields_override():
+    """The server-side adoption hook: explicit trace_id/parent_span
+    keyword fields beat the thread-local ones in the emitted record."""
+    buf = io.StringIO()
+    tracer = SpanTracer(MetricsLogger(buf))
+    with tracer.span("ps.apply", trace_id="w3", parent_span="w3.42"):
+        pass
+    rec = _spans(buf)[0]
+    assert rec["trace_id"] == "w3" and rec["parent_span"] == "w3.42"
+
+
+# -- wire propagation (real sockets) -----------------------------------------
+
+def _run_traffic(buf, max_wire_version=2, client_wire=None):
+    sink = MetricsLogger(buf)
+    ps = DeltaParameterServer(tree([0.0]), num_workers=2)
+    with SocketParameterServer(
+            ps, max_wire_version=max_wire_version,
+            tracer=SpanTracer(sink, registry=ps.registry)) as server:
+        ctracer = SpanTracer(sink)
+        ctracer.set_trace_id("w0")
+        with PSClient("127.0.0.1", server.port, 0, tracer=ctracer,
+                      wire_version=client_wire) as c:
+            wire = c.wire_version
+            c.pull()
+            c.commit(tree([1.0]), gap_s=0.02)
+            c.commit(tree([1.0]), gap_s=0.02)
+    return ps, wire
+
+
+def test_v2_trace_ids_end_to_end():
+    buf = io.StringIO()
+    ps, wire = _run_traffic(buf)
+    assert wire == 2
+    commits = _spans(buf, "ps.commit")
+    applies = _spans(buf, "ps.apply")
+    assert len(commits) == 2 and len(applies) == 2
+    commit_ids = {c["span_id"] for c in commits}
+    for a in applies:
+        # the server span ADOPTED the remote context: worker trace id,
+        # parented on the worker's commit span
+        assert a["trace_id"] == "w0"
+        assert a["parent_span"] in commit_ids
+    # pull serve spans adopted the trace too
+    serves = _spans(buf, "ps.serve_pull")
+    assert serves and all(s["trace_id"] == "w0" for s in serves)
+    # span durations also landed in the PS registry
+    assert ps.registry.get("span.ps.apply.seconds").count == 2
+
+
+@pytest.mark.parametrize("kw", [dict(max_wire_version=1),
+                                dict(client_wire=1)])
+def test_v1_peers_interop_without_trace(kw):
+    """A v1 peer on either end: commits/pulls work, gap_s still feeds the
+    detector (harmless extra key), but no trace header crosses the wire —
+    apply spans stay server-local (no adopted trace id, no parent)."""
+    buf = io.StringIO()
+    ps, wire = _run_traffic(buf, **kw)
+    assert wire == 1
+    assert ps.num_updates == 2  # traffic itself unaffected
+    applies = _spans(buf, "ps.apply")
+    assert len(applies) == 2
+    for a in applies:
+        assert a["trace_id"] != "w0"       # server-local lazy trace id
+        assert "parent_span" not in a      # nothing to link to
+    # no server pull spans for untraced pulls
+    assert not _spans(buf, "ps.serve_pull")
+    # liveness signal survived the downgrade: gap_s still fed the detector
+    assert ps.registry.get("ps.heartbeat_gap_ewma.worker0") is not None
+
+
+def test_trace_header_absent_without_tracer():
+    """No tracer on the client -> no trace key in the commit msg (the
+    header is opt-in, not ambient)."""
+    seen = []
+    ps = DeltaParameterServer(tree([0.0]), num_workers=1)
+    with SocketParameterServer(
+            ps, fault_injector=lambda a, m: seen.append(dict(m)) and False
+            ) as server:
+        with PSClient("127.0.0.1", server.port, 0) as c:
+            c.commit(tree([1.0]))
+    assert seen and "trace" not in seen[0]
+
+
+# -- straggler detector unit -------------------------------------------------
+
+def test_detector_ewma_and_leave_one_out_flagging():
+    reg = Registry()
+    det = StragglerDetector(registry=reg, alpha=0.5)
+    for _ in range(6):
+        det.record(0, 0.01)
+        det.record(1, 0.01)
+    assert det.stragglers == []
+    for _ in range(6):
+        det.record(0, 0.01)
+        flagged = det.record(1, 0.5)
+    # leave-one-out median: worker 1 judged against worker 0 alone — the
+    # 2-worker fleet CAN flag (a self-inclusive median never could at k=3)
+    assert flagged and det.stragglers == [1]
+    assert reg.gauge("ps.stragglers").value == 1
+    assert reg.gauge("ps.heartbeat_gap_ewma.worker1").value > \
+        reg.gauge("ps.heartbeat_gap_ewma.worker0").value
+    snap = det.snapshot()
+    assert snap["stragglers"] == [1] and "1" in snap["gap_ewma_s"]
+    # recovery: gaps normalize -> flag clears
+    for _ in range(20):
+        det.record(0, 0.01)
+        det.record(1, 0.01)
+    assert det.stragglers == []
+    assert reg.gauge("ps.stragglers").value == 0
+
+
+def test_detector_single_worker_never_flags():
+    det = StragglerDetector()
+    for gap in (0.01, 5.0, 50.0):
+        assert det.record(0, gap) is False
+    assert det.stragglers == []
+
+
+def test_detector_min_gap_floor_suppresses_toy_jitter():
+    det = StragglerDetector(min_gap_s=1e-3)
+    for _ in range(8):
+        det.record(0, 1e-5)
+        det.record(1, 1e-4)  # 10x the peer, but far under the floor
+    assert det.stragglers == []
+
+
+def test_detector_warns_once_per_incident(caplog):
+    det = StragglerDetector(alpha=1.0)
+    with caplog.at_level(logging.WARNING,
+                         logger="distkeras_tpu.obs.stragglers"):
+        for _ in range(5):       # one continuous incident: ONE warn
+            det.record(0, 0.01)
+            det.record(1, 2.0)
+        for _ in range(5):       # full recovery re-arms the warn
+            det.record(0, 0.01)
+            det.record(1, 0.01)
+        assert det.stragglers == []
+        det.record(0, 0.01)
+        det.record(1, 3.0)       # a NEW incident: second warn
+    warns = [r for r in caplog.records if "straggler" in r.message]
+    assert len(warns) == 2
+    assert all("worker 1" in w.getMessage() for w in warns)
+
+
+def test_detector_hostile_inputs():
+    det = StragglerDetector()
+    assert det.record("x", 0.1) is False
+    assert det.record(0, None) is False
+    assert det.record(0, -1.0) is False
+    assert det.record(0, float("nan")) is False
+    assert det.record(0, float("inf")) is False
+    assert det.snapshot()["gap_ewma_s"] == {}
+
+
+def test_detector_nan_gap_cannot_poison_fleet():
+    """gap_s comes off the untrusted wire: one NaN must not wedge a
+    worker's EWMA at NaN (which would also break every peer median and
+    silently disable detection for the whole fleet)."""
+    det = StragglerDetector()
+    det.record(0, 0.01)
+    det.record(1, float("nan"))  # rejected, not folded in
+    for _ in range(8):
+        det.record(0, 0.01)
+        det.record(1, 5.0)
+    assert det.stragglers == [1]
+
+
+def test_detect_from_heartbeats_replay():
+    recs = []
+    for i in range(8):
+        recs.append({"event": "heartbeat", "worker_id": 0, "gap_s": 0.01})
+        recs.append({"event": "heartbeat", "worker_id": 1, "gap_s": 0.9})
+        recs.append({"event": "heartbeat", "worker": 2, "gap_s": 0.01})
+    recs.append({"event": "heartbeat", "worker_id": 3})           # no gap_s
+    recs.append({"event": "epoch", "epoch": 0})                   # ignored
+    snap = detect_from_heartbeats(recs)
+    assert snap["stragglers"] == [1]
+    assert set(snap["gap_ewma_s"]) == {"0", "1", "2"}  # worker key fallback
+
+
+# -- acceptance: delayed worker in a threaded async run ----------------------
+
+def _window_fn(delay):
+    def fn(variables, opt_state, rng, wx, wy):
+        time.sleep(delay)
+        return variables, opt_state, rng, np.zeros(wx.shape[0], np.float32)
+    return fn
+
+
+def test_delayed_worker_flagged_live_and_linked_in_timeline(capsys):
+    """One artificially delayed worker in a threaded async run:
+    ``ps.stragglers >= 1`` in the LIVE stats RPC, and the obsview
+    timeline links >= 1 server apply span to that worker's trace id."""
+    buf = io.StringIO()
+    sink = MetricsLogger(buf)
+    center = tree([0.0, 0.0])
+    ps = DeltaParameterServer(center, num_workers=2)
+    n_windows, w, batch = 6, 1, 2
+    xs = np.zeros((n_windows, w, batch, 2), np.float32)
+    ys = np.zeros((n_windows, w, batch), np.float32)
+    with SocketParameterServer(
+            ps, tracer=SpanTracer(sink, registry=ps.registry)) as server:
+        workers = []
+        for k, delay in ((0, 0.12), (1, 0.005)):
+            wk = PullCommitWorker(k, _window_fn(delay), tree([0.0, 0.0]),
+                                  {}, None, "127.0.0.1", server.port,
+                                  num_epoch=1, metrics=sink)
+            wk.set_data(xs, ys)
+            workers.append(wk)
+        for wk in workers:
+            wk.start()
+        for wk in workers:
+            wk.join()
+        assert all(wk.error is None for wk in workers), \
+            [wk.error for wk in workers]
+        # live poll while the server still runs (the acceptance check)
+        with PSClient("127.0.0.1", server.port, 99) as poller:
+            reply = poller.stats()
+    stats = reply["stats"]
+    assert stats["ps.stragglers"]["value"] >= 1
+    assert "0" in json.dumps(reply["stragglers"]["stragglers"]) or \
+        0 in reply["stragglers"]["stragglers"]
+    assert reply["stragglers"]["gap_ewma_s"]["0"] > \
+        reply["stragglers"]["gap_ewma_s"]["1"]
+
+    # heartbeat records are self-contained: worker_id + monotonic gap_s
+    hbs = [r for r in _records(buf) if r["event"] == "heartbeat"]
+    assert len(hbs) == 2 * n_windows
+    for h in hbs:
+        assert h["worker_id"] in (0, 1)
+        assert h["gap_s"] > 0
+    slow = [h["gap_s"] for h in hbs if h["worker_id"] == 0]
+    assert min(slow) >= 0.1  # the injected delay dominates the gap
+
+    # obsview: timeline section links the slow worker's trace
+    out = obsview.summarize(_records(buf))
+    assert "Cross-process timeline" in out
+    assert "Stragglers" in out and "STRAGGLER" in out
+    spans = [r for r in _records(buf) if r["event"] == "span"]
+    w0_commits = {s["span_id"] for s in spans
+                  if s["name"] == "ps.commit" and s["trace_id"] == "w0"}
+    linked = [s for s in spans if s["name"] == "ps.apply"
+              and s["trace_id"] == "w0"
+              and s.get("parent_span") in w0_commits]
+    assert len(linked) >= 1
+
+    # the straggler state also renders in the live-poll view
+    live = obsview.summarize_stats(reply)
+    assert "Stragglers (live)" in live and "STRAGGLER" in live
+
+
+def test_obsview_live_cli_shows_straggler_gauge(capsys):
+    """obsview --ps surfaces ps.stragglers without any new flags."""
+    ps = DeltaParameterServer(tree([0.0]), num_workers=2)
+    with SocketParameterServer(ps) as server:
+        with PSClient("127.0.0.1", server.port, 0) as c0, \
+                PSClient("127.0.0.1", server.port, 1) as c1:
+            for _ in range(6):
+                c0.commit(tree([0.0]), gap_s=0.01)
+                c1.commit(tree([0.0]), gap_s=1.0)
+        assert obsview.main(["--ps", f"127.0.0.1:{server.port}"]) == 0
+    out = capsys.readouterr().out
+    assert "ps.stragglers: 1" in out
+    assert "Stragglers (live)" in out
