@@ -205,8 +205,16 @@ def main():
     # bench-scoped registry: the trainer's span durations (jit_compile /
     # train) histogram into it, per-epoch wall/throughput observations are
     # folded in below — the distribution snapshot the ROADMAP telemetry
-    # item wants persisted beside the wall-clock row (ISSUE 5)
+    # item wants persisted beside the wall-clock row (ISSUE 5).  The
+    # profiling layer (ISSUE 6) lands here too: the retrace sentinel's
+    # jit.compiles/jit.retraces and the per-epoch mem.* watermark gauges
+    # all resolve to the tracer's registry.  Pre-create the jit counters
+    # so the snapshot carries them even at zero — a missing metric is
+    # only a drift-gate NOTE; a present 0 -> 1 jump is drift (the
+    # OBS_BASELINE.json jit.retraces rule: any increase fails).
     breg = Registry()
+    breg.counter("jit.compiles")
+    breg.counter("jit.retraces")
     trainer.tracer.registry = breg
     trainer.train(ds)
 

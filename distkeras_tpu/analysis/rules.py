@@ -1,6 +1,6 @@
 """dklint rules — repo-specific static checks for a distributed-JAX stack.
 
-Five rules, each targeting a hazard class this codebase actually has
+Six rules, each targeting a hazard class this codebase actually has
 (ISSUE 3; the PS stack is exactly the shape of code where these corrupt
 training without failing a test):
 
@@ -21,6 +21,13 @@ training without failing a test):
 * ``bare-print``      — ``print(`` in library code (output goes through
   ``obs.logging``'s ``emit``/``get_logger`` seam); migrated here from
   the one-off AST gate PR 2 shipped in ``tests/test_obs.py``.
+* ``staleness-protocol`` — commits built from a center pulled BEFORE the
+  previous commit's reply (ISSUE 6, carried from ROADMAP): a ``commit``
+  repeated — in a loop, or back-to-back — without a fresh ``pull`` on
+  the same receiver trains every window after the first against a stale
+  center.  The async algorithms' contract is pull-per-window; this is
+  the lexical check for the one protocol slip a test's loss curve
+  rarely catches.
 """
 
 from __future__ import annotations
@@ -496,12 +503,168 @@ class BarePrintRule(Rule):
         ]
 
 
+# ---------------------------------------------------------------------------
+# staleness-protocol
+# ---------------------------------------------------------------------------
+
+
+def _walk_same_scope(node: ast.AST):
+    """Yield ``node`` and descendants WITHOUT descending into nested
+    function/class/lambda bodies — a pull inside a nested def is not a
+    pull on this scope's protocol timeline."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _rpc_receiver(call: ast.Call, method: str) -> Optional[str]:
+    """``client.pull(...)`` -> ``"client"`` (dotted receivers included:
+    ``self._client.commit`` -> ``"self._client"``), else None."""
+    if isinstance(call.func, ast.Attribute) and call.func.attr == method:
+        return _dotted(call.func.value)
+    return None
+
+
+class StalenessProtocolRule(Rule):
+    id = "staleness-protocol"
+    description = ("commits built from a center pulled before the previous "
+                   "commit's reply (a repeated commit with no fresh pull on "
+                   "the same receiver)")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_fn(ctx, node, findings)
+        return findings
+
+    def _check_fn(self, ctx: FileContext, fn: ast.AST,
+                  findings: List[Finding]) -> None:
+        # only receivers that PULL somewhere in this function follow the
+        # pull/commit protocol; a commit-only stream (gradient push, no
+        # center) is a different protocol, not a staleness bug
+        pulled = set()
+        for node in _walk_same_scope(fn):
+            if isinstance(node, ast.Call):
+                r = _rpc_receiver(node, "pull")
+                if r:
+                    pulled.add(r)
+        if not pulled:
+            return
+        flagged: Set[int] = set()
+
+        def flag(call: ast.Call, recv: str) -> None:
+            if id(call) in flagged:
+                return
+            flagged.add(id(call))
+            findings.append(self.finding(
+                ctx, call,
+                f"`{recv}.commit(...)` repeats without a fresh "
+                f"`{recv}.pull()` since the previous commit — the delta "
+                f"is built from a center pulled before the previous "
+                f"commit's reply; pull at every window boundary"))
+
+        def events_in(stmts) -> Tuple[Set[str], dict]:
+            pulls: Set[str] = set()
+            commits: dict = {}
+            for stmt in stmts:
+                for node in _walk_same_scope(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    r = _rpc_receiver(node, "pull")
+                    if r in pulled:
+                        pulls.add(r)
+                    r = _rpc_receiver(node, "commit")
+                    if r in pulled and r not in commits:
+                        commits[r] = node
+            return pulls, commits
+
+        # state per receiver: None (no pull yet — protocol not started),
+        # "fresh" (pulled since the last commit), "stale" (committed
+        # since the last pull).  Exclusive branches (if/else, try
+        # handlers) each run on a COPY and merge optimistically — fresh
+        # beats None beats stale — so one commit per mutually exclusive
+        # branch is never misread as a repeated commit.
+        _RANK = {"fresh": 0, None: 1, "stale": 2}
+
+        def merge(*branch_states: dict) -> dict:
+            keys = set().union(*[set(s) for s in branch_states])
+            return {k: min((s.get(k) for s in branch_states),
+                           key=_RANK.__getitem__) for k in keys}
+
+        def visit(stmts, state: dict) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                    body = list(stmt.body) + list(stmt.orelse)
+                    pulls_in, commits_in = events_in(body)
+                    for recv, call in commits_in.items():
+                        # a loop that commits but never pulls re-commits
+                        # from whatever was pulled BEFORE the loop
+                        if recv not in pulls_in and \
+                                state.get(recv) is not None:
+                            flag(call, recv)
+                    visit(stmt.body, state)
+                    visit(stmt.orelse, state)
+                    continue
+                if isinstance(stmt, ast.If):
+                    branches = []
+                    for body in (stmt.body, stmt.orelse):
+                        b = dict(state)
+                        visit(body, b)
+                        branches.append(b)
+                    state.clear()
+                    state.update(merge(*branches))
+                    continue
+                if isinstance(stmt, ast.Try):
+                    main = dict(state)
+                    visit(list(stmt.body) + list(stmt.orelse), main)
+                    paths = [main]
+                    for h in stmt.handlers:  # exceptional alternates
+                        hb = dict(state)
+                        visit(h.body, hb)
+                        paths.append(hb)
+                    state.clear()
+                    state.update(merge(*paths))
+                    visit(stmt.finalbody, state)
+                    continue
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    visit(stmt.body, state)
+                    continue
+                # plain statement: protocol events in lexical order
+                calls = [n for n in _walk_same_scope(stmt)
+                         if isinstance(n, ast.Call)]
+                calls.sort(key=lambda n: (n.lineno, n.col_offset))
+                for call in calls:
+                    r = _rpc_receiver(call, "pull")
+                    if r in pulled:
+                        state[r] = "fresh"
+                        continue
+                    r = _rpc_receiver(call, "commit")
+                    if r in pulled:
+                        if state.get(r) == "stale":
+                            flag(call, r)
+                        if state.get(r) is not None:
+                            state[r] = "stale"
+
+        visit(fn.body, {})
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     JitPurityRule(),
     LockDisciplineRule(),
     SwallowGuardRule(),
     ThreadShutdownRule(),
     BarePrintRule(),
+    StalenessProtocolRule(),
 )
 
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}

@@ -16,6 +16,15 @@ On top of the raw telemetry sits the regression-tracking layer (ISSUE 5):
 deltas, bucket-wise PSI + quantile shift, thresholds from the committed
 ``OBS_BASELINE.json``) and ``stragglers`` turns per-window worker
 heartbeat gaps into a live ``ps.stragglers`` gauge.
+
+The profiling layer (ISSUE 6): ``profile`` adds the recompilation
+sentinel (``jit.compiles``/``jit.retraces``, drift-gated), memory
+watermarks (``mem.*`` gauges sampled at the heartbeat points), the
+opt-in ``block_until_ready`` host/device step-time split, and the one
+sanctioned ``jax.profiler`` capture seam; ``export`` renders the
+span/heartbeat JSONL as a Chrome/Perfetto trace
+(``obsview --export-trace``) with the PR 5 cross-process links drawn as
+flow arrows.
 """
 
 from .registry import (  # noqa: F401
@@ -32,6 +41,16 @@ from .spans import SpanTracer, default_tracer, set_default_sink, span  # noqa: F
 from .exposition import to_prometheus_text  # noqa: F401
 from .logging import emit, enable_stderr_logging, get_logger  # noqa: F401
 from .stragglers import StragglerDetector, detect_from_heartbeats  # noqa: F401
+from .profile import (  # noqa: F401
+    ProfileConfig,
+    RetraceSentinel,
+    device_trace,
+    memory_snapshot,
+    observe_memory,
+    step_split,
+    tree_signature,
+)
+from .export import records_to_chrome_trace, write_chrome_trace  # noqa: F401
 from .drift import (  # noqa: F401
     BASELINE_SCHEMA,
     DEFAULT_THRESHOLDS,

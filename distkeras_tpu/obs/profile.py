@@ -1,0 +1,338 @@
+"""Profiling layer over the registries/spans (ISSUE 6 tentpole).
+
+PR 2 gave the stack metrics and spans, PR 5 a drift gate — but none of it
+answers the three questions a perf regression actually raises: *did
+something recompile*, *where did the HBM go*, and *is the step host-bound
+or device-bound*.  Four instruments, all feeding the existing registries
+so ``obs.drift`` gates them like any other metric:
+
+* **Recompilation sentinel** (``RetraceSentinel``) — tracks the arg
+  signature (pytree structure + per-leaf shape/dtype) of every jit entry
+  point.  The first signature is the cold compile (``jit.compiles``);
+  any NEW signature later is a retrace (``jit.retraces``), the silent
+  throughput killer SURVEY.md §7 names — logged once per signature with
+  the offending shape/dtype hash, and drift-gated by the committed
+  ``OBS_BASELINE.json`` (any increase fails ``obsview --diff``).
+* **Memory watermarks** (``memory_snapshot`` / ``observe_memory``) —
+  live device-array bytes (``jax.live_arrays()``), array count, a
+  max-tracked ``mem.peak_live_bytes`` gauge, and the backend allocator's
+  ``peak_bytes_in_use`` where the platform reports it (TPU/GPU; CPU
+  returns none).  Sampled at the existing heartbeat points: trainer
+  epoch records and async-worker window heartbeats.
+* **Step-time split** (``step_split``) — wraps a step/window function so
+  every call observes host dispatch time (call → return, i.e. trace +
+  enqueue) and device execution time (return → ``block_until_ready``)
+  into separate ``step.host_seconds`` / ``step.device_seconds``
+  histograms.  Opt-in via ``ProfileConfig.step_split``: the hard sync
+  per call defeats the epoch pipelining the trainers use for honest
+  headline timing, so it is a profiling mode, not a default.
+* **Device trace seam** (``device_trace``) — the one sanctioned
+  ``jax.profiler`` start/stop wrapper: announces the output dir once via
+  ``obs.logging``, and never leaks an open trace session on exception
+  paths (a failing ``stop_trace`` is logged, not allowed to mask the
+  body's error).  ``utils.metrics.profile_trace`` delegates here, and
+  ``ProfileConfig.trace_dir`` requests per-epoch captures from trainer
+  config.
+
+``ProfileConfig`` is the trainer-facing knob bundle
+(``Trainer(..., profile=...)`` accepts a ``ProfileConfig``, a dict of
+its fields, or a bare path string meaning ``trace_dir``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+import threading
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+from .logging import get_logger
+from .registry import Registry, TIME_BUCKETS, default_registry
+
+#: live-byte buckets for the optional watermark histogramming — gauges are
+#: the primary surface (levels), these exist for callers that want a
+#: distribution over a long run
+_LOG = "obs.profile"
+
+
+# ---------------------------------------------------------------------------
+# recompilation sentinel
+# ---------------------------------------------------------------------------
+
+def tree_signature(args: Any) -> Tuple:
+    """Hashable retrace signature of a call's arguments: the pytree
+    structure plus each array leaf's ``(shape, dtype)``.  Non-array leaves
+    contribute their type only (jit specializes on structure and
+    shape/dtype, not on array values; hashing Python scalar VALUES would
+    report a retrace for every new step count).  Matches what actually
+    triggers an XLA re-trace for the static-shape programs this repo
+    compiles."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append((tuple(shape), str(dtype)))
+        else:
+            sig.append(type(leaf).__name__)
+    return treedef, tuple(sig)
+
+
+def signature_digest(sig: Tuple) -> str:
+    """Short stable hash of a ``tree_signature`` — what the one-time
+    retrace log (and the JSONL ``retrace`` record) names, so two runs can
+    be compared by signature without dumping whole shape trees."""
+    return hashlib.sha1(repr(sig).encode()).hexdigest()[:12]
+
+
+class RetraceSentinel:
+    """Counts cold compiles and retraces of ONE jit entry point.
+
+    ``observe(args)`` returns ``"cold"`` (first signature ever),
+    ``"warm"`` (seen before — the steady state) or ``"retrace"`` (a NEW
+    signature after the first: XLA recompiles synchronously inside this
+    call).  Counters land in ``registry`` — an ``obs.Registry``, a
+    zero-arg callable returning one (resolved per event, so a registry
+    attached after construction still receives the counts), or None for
+    the process-wide default.  Retraces log once per signature (warning —
+    they are the regression this sentinel exists to catch) and, with a
+    ``sink``, emit a ``retrace`` record into the JSONL stream."""
+
+    def __init__(self, name: str, registry=None, sink=None):
+        self.name = name
+        self._registry = registry
+        self.sink = sink
+        self._sigs: dict = {}   # signature -> digest
+        self._lock = threading.Lock()
+
+    def _reg(self) -> Registry:
+        reg = self._registry() if callable(self._registry) else self._registry
+        return reg if reg is not None else default_registry()
+
+    @property
+    def compiles(self) -> int:
+        return len(self._sigs)
+
+    def observe(self, args: Any) -> str:
+        sig = tree_signature(args)
+        with self._lock:
+            if sig in self._sigs:
+                return "warm"
+            first = not self._sigs
+            digest = signature_digest(sig)
+            self._sigs[sig] = digest
+            n_retrace = len(self._sigs) - 1
+        reg = self._reg()
+        reg.counter("jit.compiles").inc()
+        if first:
+            return "cold"
+        reg.counter("jit.retraces").inc()
+        # once per signature by construction: a signature enters _sigs
+        # exactly once, and only that insertion reaches this path
+        get_logger(_LOG).warning(
+            "%s: retrace #%d — new arg signature %s (shapes/dtypes changed "
+            "since the cold compile; steady-state steps should never "
+            "re-trace)", self.name, n_retrace, digest)
+        if self.sink is not None:
+            self.sink.log("retrace", entry=self.name, signature=digest,
+                          retraces=n_retrace)
+        return "retrace"
+
+    def wrap(self, fn: Callable) -> Callable:
+        """``fn`` with every call observed (counting only — the cold/warm
+        split callers like the trainers' ``jit_compile`` span need is
+        theirs to build from ``observe``)."""
+        def wrapped(*args):
+            self.observe(args)
+            return fn(*args)
+        return wrapped
+
+
+# ---------------------------------------------------------------------------
+# memory watermarks
+# ---------------------------------------------------------------------------
+
+#: guards the read-modify-write on the max-tracked peak gauges (Gauge ops
+#: are individually locked, but max() needs the pair to be atomic across
+#: concurrently-heartbeating workers)
+_PEAK_LOCK = threading.Lock()
+
+
+def memory_snapshot() -> dict:
+    """Point-in-time device-memory accounting: ``live_bytes`` /
+    ``live_arrays`` from ``jax.live_arrays()`` (every live ``jax.Array``
+    this process holds), plus ``device_peak_bytes`` — the backend
+    allocator's ``peak_bytes_in_use`` summed over devices — where the
+    platform reports it (TPU/GPU; CPU's ``memory_stats()`` is None)."""
+    import jax
+    live_bytes = 0
+    count = 0
+    for a in jax.live_arrays():
+        try:
+            live_bytes += int(a.nbytes)
+            count += 1
+        except RuntimeError:
+            continue  # deleted/donated between enumeration and read
+    snap = {"live_bytes": live_bytes, "live_arrays": count,
+            "device_peak_bytes": None}
+    peak = 0
+    seen = False
+    for d in jax.devices():
+        try:
+            stats = d.memory_stats()
+        except (RuntimeError, NotImplementedError, AttributeError):
+            stats = None
+        if stats and stats.get("peak_bytes_in_use") is not None:
+            peak += int(stats["peak_bytes_in_use"])
+            seen = True
+    if seen:
+        snap["device_peak_bytes"] = peak
+    return snap
+
+
+def observe_memory(registry: Optional[Registry] = None) -> dict:
+    """Sample ``memory_snapshot`` into watermark gauges:
+    ``mem.live_bytes`` / ``mem.live_arrays`` (levels),
+    ``mem.peak_live_bytes`` (max over every sample this registry saw —
+    the HBM high-water mark the OOM postmortem wants), and
+    ``mem.device_peak_bytes`` when the backend reports it.  Returns the
+    snapshot so call sites (epoch records, worker heartbeats) can stamp
+    the bytes into their JSONL record too."""
+    snap = memory_snapshot()
+    reg = registry if registry is not None else default_registry()
+    reg.gauge("mem.live_bytes").set(snap["live_bytes"])
+    reg.gauge("mem.live_arrays").set(snap["live_arrays"])
+    with _PEAK_LOCK:
+        peak = reg.gauge("mem.peak_live_bytes")
+        if snap["live_bytes"] > peak.value:
+            peak.set(snap["live_bytes"])
+    if snap["device_peak_bytes"] is not None:
+        reg.gauge("mem.device_peak_bytes").set(snap["device_peak_bytes"])
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# step-time split
+# ---------------------------------------------------------------------------
+
+def step_split(fn: Callable, registry=None, prefix: str = "step") -> Callable:
+    """Wrap a step/window function with the host/device time split: the
+    call itself is host work (trace + dispatch — jit returns at enqueue
+    time), the ``block_until_ready`` that follows is device execution.
+    Observations land in ``<prefix>.host_seconds`` /
+    ``<prefix>.device_seconds`` histograms in ``registry`` (instance,
+    zero-arg callable, or None for the default registry).
+
+    The hard sync per call is exactly what the trainers' epoch pipelining
+    exists to avoid — this is a profiling mode (``ProfileConfig.
+    step_split``), not a default."""
+    import time
+
+    import jax
+
+    def wrapped(*args):
+        reg = registry() if callable(registry) else registry
+        reg = reg if reg is not None else default_registry()
+        t0 = time.perf_counter()
+        out = fn(*args)
+        t1 = time.perf_counter()
+        jax.block_until_ready(out)
+        t2 = time.perf_counter()
+        reg.histogram(f"{prefix}.host_seconds", TIME_BUCKETS).observe(t1 - t0)
+        reg.histogram(f"{prefix}.device_seconds",
+                      TIME_BUCKETS).observe(t2 - t1)
+        return out
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# device trace seam (jax.profiler)
+# ---------------------------------------------------------------------------
+
+#: dirs already announced — the capture log is once per destination, not
+#: once per epoch
+_ANNOUNCED: set = set()
+_ANNOUNCE_LOCK = threading.Lock()
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """Capture a ``jax.profiler`` trace of the wrapped region (open the
+    result in TensorBoard or Perfetto).  The one sanctioned start/stop
+    pair: announces the output dir once via ``obs.logging``, and on an
+    exception inside the region the trace session is still closed — a
+    ``stop_trace`` failure there is logged instead of masking the body's
+    error (the old ``utils.metrics.profile_trace`` leaked the open
+    session exactly that way)."""
+    import jax
+    log = get_logger(_LOG)
+    with _ANNOUNCE_LOCK:
+        if log_dir not in _ANNOUNCED:
+            _ANNOUNCED.add(log_dir)
+            log.info("device trace capture -> %s (open with TensorBoard or "
+                     "ui.perfetto.dev)", log_dir)
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    except BaseException:
+        try:
+            jax.profiler.stop_trace()
+        except RuntimeError as e:
+            # the body's exception is the story; a stop failure on the
+            # unwind path must not replace it (but must not hide either)
+            log.warning("device trace %s: stop_trace failed during "
+                        "exception unwind: %s", log_dir, e)
+        raise
+    else:
+        jax.profiler.stop_trace()
+
+
+# ---------------------------------------------------------------------------
+# trainer-facing config
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ProfileConfig:
+    """Profiling knobs a trainer accepts as ``profile=``.
+
+    * ``trace_dir`` — request per-epoch ``jax.profiler`` captures into
+      ``<trace_dir>/epoch<k>`` for every epoch in ``trace_epochs``
+      (None = no device capture).
+    * ``trace_epochs`` — which epochs to capture (default: epoch 0, the
+      compile-heavy one); None means every epoch.
+    * ``step_split`` — wrap the step/window programs in the
+      ``block_until_ready`` host/device split (defeats epoch pipelining;
+      profiling runs only).
+    * ``memory`` — sample memory watermarks at the existing heartbeat
+      points (per-epoch records, per-window worker heartbeats)."""
+
+    trace_dir: Optional[str] = None
+    trace_epochs: Optional[Sequence[int]] = (0,)
+    step_split: bool = False
+    memory: bool = True
+
+    def trace_epoch(self, epoch: int) -> bool:
+        """Should ``epoch`` run under a device capture?"""
+        if not self.trace_dir:
+            return False
+        return self.trace_epochs is None or epoch in tuple(self.trace_epochs)
+
+    @staticmethod
+    def resolve(spec: Union[None, str, dict, "ProfileConfig"]
+                ) -> "ProfileConfig":
+        """``None`` (defaults) | a path string (= ``trace_dir``) | a dict
+        of fields | a ready ProfileConfig."""
+        if spec is None:
+            return ProfileConfig()
+        if isinstance(spec, ProfileConfig):
+            return spec
+        if isinstance(spec, str):
+            return ProfileConfig(trace_dir=spec)
+        if isinstance(spec, dict):
+            return ProfileConfig(**spec)
+        raise TypeError(f"profile= expects None, a trace dir path, a dict "
+                        f"of ProfileConfig fields, or a ProfileConfig "
+                        f"(got {type(spec).__name__})")

@@ -17,6 +17,7 @@ import numpy as np
 
 from .data.dataset import Dataset
 from .models.model import Model
+from .obs import profile as obs_profile
 
 
 class Predictor:
@@ -48,7 +49,12 @@ class ModelPredictor(Predictor):
         self.output_col = output_col
         self.batch_size = int(batch_size)
         self._devices = devices
-        self._fn = jax.jit(self.model.predict_fn())
+        # retrace sentinel (ISSUE 6): predict batches are padded to a
+        # fixed shape, so any retrace after the cold compile means the
+        # padding contract broke — counted into ``jit.retraces``
+        self._sentinel = obs_profile.RetraceSentinel(
+            f"{type(self).__name__}.predict")
+        self._fn = self._sentinel.wrap(jax.jit(self.model.predict_fn()))
 
     def predict(self, dataset: Dataset) -> Dataset:
         x = dataset[self.features_col]
@@ -91,7 +97,12 @@ class StreamingPredictor(Predictor):
                  batch_size: int = 64):
         super().__init__(keras_model, variables)
         self.batch_size = int(batch_size)
-        self._fn = jax.jit(self.model.predict_fn())
+        # streaming contract: exactly ONE compiled shape (micro-batches
+        # pad to batch_size) — the sentinel turns any violation into a
+        # counted, logged retrace instead of a silent latency cliff
+        self._sentinel = obs_profile.RetraceSentinel(
+            f"{type(self).__name__}.predict")
+        self._fn = self._sentinel.wrap(jax.jit(self.model.predict_fn()))
 
     def _predict_batch(self, rows: list) -> np.ndarray:
         x = np.stack(rows)

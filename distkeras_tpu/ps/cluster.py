@@ -90,7 +90,8 @@ def run_cluster_async_training(trainer, dataset,
             jax.random.PRNGKey(trainer.seed + 1 + pid),
             host if pid != 0 else "127.0.0.1", int(port),
             trainer.num_epoch, metrics=trainer.metrics,
-            comm_codec=getattr(trainer, "comm_codec", "none"), **kw)
+            comm_codec=getattr(trainer, "comm_codec", "none"),
+            profile_memory=trainer.profile.memory, **kw)
         worker.set_data(xs[pid], ys[pid])
         worker.run()  # synchronously IN this process (it owns the devices)
         if worker.error is not None:

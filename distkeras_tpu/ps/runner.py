@@ -17,6 +17,8 @@ come from the partitioned ``Dataset``, and workers run as either
 
 from __future__ import annotations
 
+import glob
+import json
 import os
 import subprocess
 import sys
@@ -200,6 +202,7 @@ def _run_thread_workers(trainer, ps, server, mode, center, xs, ys, num_epoch,
                        device=dev, start_window=start_windows[k],
                        metrics=trainer.metrics,
                        comm_codec=getattr(trainer, "comm_codec", "none"),
+                       profile_memory=trainer.profile.memory,
                        **kw)
         if stream is not None:
             w.set_stream(stream.factory(k), stream.n_windows)
@@ -231,7 +234,8 @@ def _run_thread_workers(trainer, ps, server, mode, center, xs, ys, num_epoch,
             "127.0.0.1", server.port, num_epoch, device=dev,
             start_window=ps.commits_by_worker.get(w.worker_id, 0),
             metrics=trainer.metrics,
-            comm_codec=getattr(trainer, "comm_codec", "none"), **kw)
+            comm_codec=getattr(trainer, "comm_codec", "none"),
+            profile_memory=trainer.profile.memory, **kw)
         if stream is not None:
             retry.set_stream(stream.factory(w.worker_id), stream.n_windows)
         else:
@@ -320,11 +324,18 @@ def _run_process_workers(trainer, ps, server, mode, center, xs, ys,
             "aux_weight": float(trainer.aux_weight),
             "mode": mode,
             "comm_codec": getattr(trainer, "comm_codec", "none"),
+            "profile_memory": bool(trainer.profile.memory),
             "alpha": float(getattr(trainer, "alpha", 0.0)),
             "worker_id": k, "host": "127.0.0.1", "port": server.port,
             "num_epoch": num_epoch, "seed": seed,
             "start_window": int(start_window),
             "out_npz": os.path.join(td, f"out_{k}_{attempt}.npz"),
+            # the worker process's OWN telemetry stream (ISSUE 6):
+            # heartbeats + client-side wire spans under trace id w<k>,
+            # folded into the trainer's sink after join so obsview and
+            # --export-trace see both halves of every cross-process span
+            "metrics_jsonl": os.path.join(td,
+                                          f"metrics_{k}_{attempt}.jsonl"),
             "attempt": attempt,
         }
 
@@ -370,4 +381,34 @@ def _run_process_workers(trainer, ps, server, mode, center, xs, ys,
                 if p.poll() is None:
                     p.kill()
                     p.wait()
+            # fold every worker process's telemetry into the trainer's
+            # sink (failure paths included — the heartbeats are exactly
+            # what the postmortem wants) BEFORE the tempdir vanishes
+            _fold_worker_metrics(trainer, td)
     return losses
+
+
+def _fold_worker_metrics(trainer, td: str) -> None:
+    """Merge the worker processes' own JSONL streams (``metrics_jsonl``
+    in the spec — heartbeats + client wire spans under trace id ``w<k>``)
+    into the trainer's sink, original ``ts``/trace identity preserved.
+    Before this fold only the SERVER half of a process worker's spans was
+    recorded; with it, ``obsview`` and ``--export-trace`` link both
+    halves exactly as in the threads placement (ISSUE 6)."""
+    for path in sorted(glob.glob(os.path.join(td, "metrics_*.jsonl"))):
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError:
+            continue  # worker died before its sink opened
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # a killed worker's torn final line
+            # re-log under the original event name; the record's own
+            # ``ts`` overrides the fresh stamp, so timelines stay honest
+            trainer.metrics.log(rec.pop("event", "record"), **rec)

@@ -14,7 +14,12 @@ The spec is a msgpack tree (``utils.serde``):
      "compute_dtype": str|None, "mode": "pull_commit"|"staleness"|"elastic",
      "comm_codec": str (``ps.codecs`` spec, default "none"),
      "alpha": float, "worker_id": int, "host": str, "port": int,
-     "num_epoch": int, "seed": int, "data_npz": path, "out_npz": path}
+     "num_epoch": int, "seed": int, "data_npz": path, "out_npz": path,
+     "metrics_jsonl": path (optional — this process's own telemetry
+     stream: heartbeats + ``ps.commit``/``ps.pull`` spans under trace id
+     ``w<worker_id>``; the runner folds it back into the trainer's sink
+     so ``obsview --export-trace`` links BOTH halves of every wire span,
+     ISSUE 6)}
 
 Used by ``ps.runner.run_async_training`` when the trainer asks for
 ``async_workers="processes"``; also runnable by hand for manual clusters
@@ -66,13 +71,23 @@ def run_spec(spec_path: str) -> None:
     import jax
     worker_cls = _WORKER_CLASSES[spec["mode"]]
     kw = {"alpha": spec["alpha"]} if spec["mode"] == "elastic" else {}
+    # this process's own telemetry stream (ISSUE 6): the worker's tracer
+    # pins trace id ``w<worker_id>`` on its thread, so the commit/pull
+    # spans recorded HERE carry the same identity the server's adopted
+    # apply spans reference in the parent's stream — the runner merges
+    # the two halves after join
+    metrics = None
+    if spec.get("metrics_jsonl"):
+        from ..utils.metrics import MetricsLogger
+        metrics = MetricsLogger(spec["metrics_jsonl"])
     worker = worker_cls(
         int(spec["worker_id"]), window_fn, center,
         optimizer.init(center["params"]),
         jax.random.PRNGKey(int(spec["seed"])),
         spec["host"], int(spec["port"]), int(spec["num_epoch"]),
         start_window=int(spec.get("start_window", 0)),
-        comm_codec=spec.get("comm_codec", "none"), **kw)
+        comm_codec=spec.get("comm_codec", "none"), metrics=metrics,
+        profile_memory=bool(spec.get("profile_memory", True)), **kw)
     if "stream" in spec:
         # disk-streaming partition: this process reads ITS shards straight
         # from the (shared) dataset directory — nothing was staged for it
@@ -93,6 +108,8 @@ def run_spec(spec_path: str) -> None:
     # mid-epoch-1 doesn't lose epoch 0 (thread-placement parity)
     np.savez(spec["out_npz"],
              **{f"epoch_{e}": l for e, l in worker.epoch_losses.items()})
+    if metrics is not None:
+        metrics.close()
     if worker.error is not None:
         raise worker.error
 

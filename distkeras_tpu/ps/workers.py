@@ -28,6 +28,7 @@ from typing import Any, Callable, Optional
 import jax
 import numpy as np
 
+from ..obs import profile as obs_profile
 from ..obs.spans import SpanTracer
 from ..parallel.sync import _inexact, adopt_float_leaves, tmap as _tmap
 from .client import PSClient
@@ -53,7 +54,7 @@ class AsyncWorker(threading.Thread):
                  variables: Tree, opt_state: Tree, rng,
                  host: str, port: int, num_epoch: int,
                  device=None, start_window: int = 0, metrics=None,
-                 comm_codec: str = "none"):
+                 comm_codec: str = "none", profile_memory: bool = True):
         super().__init__(name=f"worker-{worker_id}", daemon=True)
         self.worker_id = worker_id
         self.window_fn = window_fn
@@ -90,6 +91,10 @@ class AsyncWorker(threading.Thread):
         #: source (``gap_s``); wall-clock diffs would absorb NTP steps
         self._last_commit_mono: Optional[float] = None
         self._gap_s: Optional[float] = None
+        #: memory-watermark sampling at the heartbeat points (ISSUE 6):
+        #: ``mem.*`` gauges in the process-wide registry + ``live_bytes``
+        #: on every heartbeat record (the per-window HBM trail)
+        self.profile_memory = bool(profile_memory)
 
     def set_data(self, xs, ys):
         self.xs, self.ys = xs, ys
@@ -200,9 +205,12 @@ class AsyncWorker(threading.Thread):
         if self.metrics is None:
             return
         _, losses = self.window_losses[-1]
+        extra = {}
+        if self.profile_memory:
+            extra["live_bytes"] = obs_profile.observe_memory()["live_bytes"]
         self.metrics.log("heartbeat", worker_id=self.worker_id, window=gw,
                          epoch=gw // n_windows, gap_s=self._gap_s,
-                         mean_loss=float(np.mean(losses)))
+                         mean_loss=float(np.mean(losses)), **extra)
 
     def _run_window(self, wx, wy):
         self.variables, self.opt_state, self.rng, losses = self.window_fn(

@@ -22,6 +22,7 @@ Under the hood nothing resembles the reference's Spark + socket-PS stack:
 
 from __future__ import annotations
 
+import os
 import time
 import warnings
 from typing import Optional
@@ -35,6 +36,8 @@ from .data.dataset import Dataset
 from .models.layers import Activation, Dense, Sequential
 from .models.model import Model
 from .obs import SpanTracer
+from .obs import profile as obs_profile
+from .obs.registry import default_registry
 from .ops.losses import get_loss, probs_loss_variant
 from .ops.optimizers import get_optimizer
 from .parallel import mesh as mesh_lib
@@ -147,7 +150,7 @@ class Trainer:
                  seed: int = 0, checkpoint_dir: Optional[str] = None,
                  checkpoint_keep: int = 3, metrics=None,
                  compute_dtype=None, remat: bool = False,
-                 aux_weight: float = 0.0):
+                 aux_weight: float = 0.0, profile=None):
         self.model = keras_model
         self.worker_optimizer = worker_optimizer
         self.loss = loss
@@ -182,9 +185,14 @@ class Trainer:
         #: per-epoch records interleave in one JSONL stream (ISSUE 2),
         #: readable by ``scripts/obsview.py``
         self.tracer = SpanTracer(self.metrics)
-        #: config keys whose jit program already ran once — the cold/warm
-        #: split behind the ``jit_compile`` span
-        self._compiled_keys: set = set()
+        #: profiling knobs (ISSUE 6): per-epoch ``jax.profiler`` captures,
+        #: the block_until_ready step-time split, memory watermarks —
+        #: ``obs.ProfileConfig`` | dict of its fields | trace-dir string
+        self.profile = obs_profile.ProfileConfig.resolve(profile)
+        #: per-(kind, config) retrace sentinels behind ``_instrumented``:
+        #: the cold/warm split for the ``jit_compile`` span AND the
+        #: ``jit.compiles``/``jit.retraces`` counters (ISSUE 6)
+        self._sentinels: dict = {}
 
         self.history: list = []
         self.training_time: float = 0.0
@@ -228,6 +236,13 @@ class Trainer:
                 self.learning_rate, str(self.compute_dtype), self.remat,
                 self.aux_weight)
 
+    def _obs_registry(self):
+        """Where this trainer's profiled metrics land: the tracer's
+        registry when one is attached (bench.py scopes a private one),
+        else the process-wide default."""
+        return self.tracer.registry if self.tracer.registry is not None \
+            else default_registry()
+
     def _instrumented(self, run, kind: str = "window"):
         """Split first-call compile time from steady-state dispatch: the
         first invocation of a freshly-built jit program (trace + XLA
@@ -235,17 +250,51 @@ class Trainer:
         ``jit_compile`` span in the metrics stream; warm calls dispatch in
         microseconds and go unobserved.  Without the split, compile time
         silently pollutes the first epoch's throughput number — exactly
-        the bias BASELINE round 5 tripped over."""
+        the bias BASELINE round 5 tripped over.
+
+        ISSUE 6: every call additionally feeds the recompilation sentinel
+        — a NEW arg signature (shape/dtype tree) after the cold compile
+        is a retrace, counted into ``jit.retraces`` (drift-gated) and
+        recorded as a ``jit_compile`` span flagged ``retrace=True``; with
+        ``profile.step_split`` the program also runs under the
+        host-dispatch / device-execution timing split."""
         key = (kind, self._config_key())
+        sentinel = self._sentinels.get(key)
+        if sentinel is None:
+            sentinel = self._sentinels[key] = obs_profile.RetraceSentinel(
+                f"{type(self).__name__}.{kind}",
+                registry=self._obs_registry, sink=self.metrics)
+        step = obs_profile.step_split(run, registry=self._obs_registry) \
+            if self.profile.step_split else run
 
         def wrapped(*args):
-            if key not in self._compiled_keys:
-                self._compiled_keys.add(key)
-                with self.tracer.span("jit_compile", kind=kind,
-                                      trainer=type(self).__name__):
-                    return run(*args)
-            return run(*args)
+            state = sentinel.observe(args)
+            if state == "warm":
+                return step(*args)
+            # compile calls bypass the step split: the seconds-long trace
+            # + XLA compile would land as one step.host_seconds sample
+            # and dominate a short profiling run — the jit_compile span
+            # already accounts for compile time separately
+            with self.tracer.span("jit_compile", kind=kind,
+                                  trainer=type(self).__name__,
+                                  **({"retrace": True}
+                                     if state == "retrace" else {})):
+                return run(*args)
         return wrapped
+
+    def _profiled_run(self, run, epoch: int, *args):
+        """One epoch-program call, optionally under a per-epoch
+        ``jax.profiler`` capture (``profile.trace_dir`` /
+        ``trace_epochs`` — ISSUE 6).  The capture blocks on the outputs
+        before stopping so the trace holds THIS epoch's device work; the
+        pipelined (uncaptured) epochs keep their no-sync dispatch."""
+        if not self.profile.trace_epoch(epoch):
+            return run(*args)
+        with obs_profile.device_trace(
+                os.path.join(self.profile.trace_dir, f"epoch{epoch}")):
+            out = run(*args)
+            jax.block_until_ready(out)
+        return out
 
     def _window_run(self):
         """Cached jit window program — repeated ``train()`` calls on an
@@ -305,10 +354,18 @@ class Trainer:
 
     def _epoch_metrics(self, epoch: int, losses: np.ndarray, dt: float,
                        samples: int) -> None:
+        extra = {}
+        if self.profile.memory:
+            # memory watermark sample at the per-epoch heartbeat point
+            # (ISSUE 6): mem.* gauges in the obs registry, live bytes on
+            # the epoch record for obsview / --export-trace
+            snap = obs_profile.observe_memory(self._obs_registry())
+            extra["live_bytes"] = snap["live_bytes"]
         self.metrics.log("epoch", trainer=type(self).__name__, epoch=epoch,
                          mean_loss=float(np.mean(losses)),
                          epoch_seconds=dt,
-                         samples_per_sec=samples / dt if dt > 0 else 0.0)
+                         samples_per_sec=samples / dt if dt > 0 else 0.0,
+                         **extra)
 
 
 class SingleTrainer(Trainer):
@@ -350,8 +407,8 @@ class SingleTrainer(Trainer):
         samples = int(xs.shape[0]) * self.batch_size
         pipe = _EpochPipeline(self, samples)
         for epoch in range(start_epoch, self.num_epoch):
-            variables, opt_state, rng, losses = run(variables, opt_state, rng,
-                                                    xs, ys)
+            variables, opt_state, rng, losses = self._profiled_run(
+                run, epoch, variables, opt_state, rng, xs, ys)
             pipe.push(epoch, losses)
             if ckpt is not None:  # note: saving implies a per-epoch sync
                 ckpt.save(epoch, (variables, opt_state, rng),
@@ -580,8 +637,8 @@ class DistributedTrainer(Trainer):
         samples = int(xs.shape[1]) * int(xs.shape[2]) * self.batch_size * P
         pipe = _EpochPipeline(self, samples, reshape=(P, -1))
         for epoch in range(start_epoch, self.num_epoch):
-            center, local, opt_state, rngs, losses = run(
-                center, local, opt_state, rngs, xs, ys)
+            center, local, opt_state, rngs, losses = self._profiled_run(
+                run, epoch, center, local, opt_state, rngs, xs, ys)
             pipe.push(epoch, losses)  # history rows: (workers, steps)
             if ckpt is not None:  # note: saving implies a per-epoch sync
                 ckpt.save(epoch, (center, local, opt_state, rngs),
@@ -761,8 +818,8 @@ class EnsembleTrainer(DistributedTrainer):
         samples = int(xs.shape[1]) * int(xs.shape[2]) * self.batch_size * P
         pipe = _EpochPipeline(self, samples, reshape=(P, -1))
         for epoch in range(start_epoch, self.num_epoch):
-            center, local, opt_state, rngs, losses = run(
-                center, local, opt_state, rngs, xs, ys)
+            center, local, opt_state, rngs, losses = self._profiled_run(
+                run, epoch, center, local, opt_state, rngs, xs, ys)
             pipe.push(epoch, losses)
             if ckpt is not None:
                 ckpt.save(epoch, (center, local, opt_state, rngs),
@@ -949,10 +1006,24 @@ class SpmdTrainer(Trainer):
             out_sh = (*carry_sh, mesh_lib.replicated(mesh))  # losses
             pinned = jax.jit(run, donate_argnums=(0, 1, 2),
                              out_shardings=out_sh)
+            # retrace sentinel for the AOT seam (ISSUE 6): the explicit
+            # compile is the entry point here, so feed the sentinel the
+            # data shapes directly — a second compile under the same
+            # config is a shape-change retrace, counted like the
+            # implicit-jit paths
+            sentinel = self._sentinels.get(("aot", self._config_key()))
+            if sentinel is None:
+                sentinel = self._sentinels[("aot", self._config_key())] = \
+                    obs_profile.RetraceSentinel(
+                        f"{type(self).__name__}.aot",
+                        registry=self._obs_registry, sink=self.metrics)
+            state = sentinel.observe((xs, ys))
             # explicit AOT compile: the one place compile time is exactly
             # measurable rather than inferred from a cold first step
             with self.tracer.span("aot_compile",
-                                  trainer=type(self).__name__):
+                                  trainer=type(self).__name__,
+                                  **({"retrace": True}
+                                     if state == "retrace" else {})):
                 self._aot_cache = (akey,
                                    pinned.lower(variables, opt_state, rng,
                                                 xs, ys).compile())
@@ -960,8 +1031,8 @@ class SpmdTrainer(Trainer):
         samples = int(xs.shape[0]) * self.batch_size
         pipe = _EpochPipeline(self, samples)
         for epoch in range(start_epoch, self.num_epoch):
-            variables, opt_state, rng, losses = compiled(variables, opt_state,
-                                                         rng, xs, ys)
+            variables, opt_state, rng, losses = self._profiled_run(
+                compiled, epoch, variables, opt_state, rng, xs, ys)
             pipe.push(epoch, losses)
             if ckpt is not None:  # note: saving implies a per-epoch sync
                 ckpt.save(epoch, (variables, opt_state, rng), {"epoch": epoch})
@@ -1198,8 +1269,8 @@ class PipelineTrainer(Trainer):
         samples = int(xs.shape[0]) * self.batch_size
         pipe = _EpochPipeline(self, samples)
         for epoch in range(start_epoch, self.num_epoch):
-            variables, opt_state, rng, losses = run(variables, opt_state,
-                                                    rng, xs, ys)
+            variables, opt_state, rng, losses = self._profiled_run(
+                run, epoch, variables, opt_state, rng, xs, ys)
             pipe.push(epoch, losses)
             if ckpt is not None:  # note: saving implies a per-epoch sync
                 ckpt.save(epoch, (variables, opt_state, rng), {"epoch": epoch})

@@ -147,13 +147,15 @@ def profile_trace(log_dir: str):
 
         with profile_trace("/tmp/trace"):
             trainer.train(ds)
-    """
-    import jax
-    jax.profiler.start_trace(log_dir)
-    try:
+
+    Thin alias for ``obs.profile.device_trace`` (ISSUE 6) — the one
+    sanctioned start/stop seam: the output dir is announced once via
+    ``obs.logging`` and the trace session can no longer leak open on
+    exception paths (this helper used to own a bare start/stop pair that
+    did exactly that when ``stop_trace`` failed during unwind)."""
+    from ..obs.profile import device_trace
+    with device_trace(log_dir):
         yield
-    finally:
-        jax.profiler.stop_trace()
 
 
 class StepTimer:

@@ -20,6 +20,14 @@ Three modes:
   committed ``OBS_BASELINE.json`` (or ``--thresholds FILE``).
   CI-friendly exit codes: 0 clean, 1 drift detected, 2 usage error.
 
+The file mode takes ``--export-trace OUT.json`` (ISSUE 6) to write the
+stream as a Chrome Trace Event Format document instead of printing the
+summary: open it at ui.perfetto.dev (or chrome://tracing) and a
+multi-worker async run reads as one linked timeline — one process row
+per worker, server applies nested under the worker commits that caused
+them (the PR 5 wire-carried trace context drawn as flow arrows),
+heartbeats as instants, ``live_bytes`` watermarks as counter tracks.
+
 The file mode also accepts a persisted registry-snapshot JSON (the
 ``BENCH_PS_OBS.json`` / ``BENCH_TRAINER_OBS.json`` that ``bench.py``
 writes beside BENCH_r*.json): per-registry instrument tables plus the
@@ -487,10 +495,17 @@ def main(argv=None) -> int:
     ap.add_argument("--prometheus", action="store_true",
                     help="with --ps (or a ps_stats record): render the "
                          "registry snapshot as Prometheus text")
+    ap.add_argument("--export-trace", metavar="OUT",
+                    help="with a JSONL file: write the stream as a "
+                         "Chrome Trace Event Format JSON (open at "
+                         "ui.perfetto.dev) instead of printing the "
+                         "summary")
     args = ap.parse_args(argv)
 
     if sum(map(bool, (args.jsonl, args.ps, args.diff))) != 1:
         ap.error("need exactly one of JSONL, --ps or --diff")
+    if args.export_trace and not args.jsonl:
+        ap.error("--export-trace needs a JSONL metrics file")
 
     if args.diff:
         return run_diff(args.diff[0], args.diff[1], args.thresholds)
@@ -505,6 +520,18 @@ def main(argv=None) -> int:
         return 0
 
     snap = load_snapshot(args.jsonl)
+    if args.export_trace:
+        if snap is not None:
+            emit(f"obsview --export-trace: {args.jsonl} is a registry "
+                 "snapshot, not a JSONL record stream (nothing to put on "
+                 "a timeline)", err=True)
+            return 2
+        from distkeras_tpu.obs import export as obs_export
+        doc = obs_export.write_chrome_trace(load_records(args.jsonl),
+                                            args.export_trace)
+        emit(f"wrote {len(doc['traceEvents'])} trace events -> "
+             f"{args.export_trace} (open at ui.perfetto.dev)")
+        return 0
     if snap is not None:
         if args.prometheus:
             # a snapshot file may hold several component registries;

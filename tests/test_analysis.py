@@ -331,6 +331,109 @@ def test_bare_print_rule():
 
 
 # ---------------------------------------------------------------------------
+# staleness-protocol
+# ---------------------------------------------------------------------------
+
+def test_staleness_protocol_flags_pull_once_commit_loop():
+    # the canonical slip (ISSUE 6, carried from ROADMAP): pull before the
+    # loop, commit every window — every commit after the first is built
+    # from a center pulled before the previous commit's reply
+    found = lint("""
+        def train(client, windows):
+            center, seen = client.pull()
+            for w in windows:
+                delta = step(center, w)
+                client.commit(delta)
+        """, rule="staleness-protocol")
+    assert len(found) == 1
+    assert "client.commit" in found[0].message
+    assert "pull" in found[0].message
+
+
+def test_staleness_protocol_flags_back_to_back_commits():
+    found = lint("""
+        def train(client):
+            center, _ = client.pull()
+            client.commit(step(center))
+            client.commit(step(center))
+        """, rule="staleness-protocol")
+    assert len(found) == 1
+
+
+def test_staleness_protocol_negatives():
+    found = lint("""
+        def per_window(client, windows):
+            for w in windows:
+                center, _ = client.pull()
+                client.commit(step(center, w))
+
+        def push_only(client, windows):
+            for w in windows:     # no pull anywhere: a different protocol
+                client.commit(grad(w))
+
+        def warm_then_loop(client, windows):
+            client.pull()         # connection warm-up
+            for w in windows:
+                center, _ = client.pull()
+                client.commit(step(center, w))
+
+        def commit_then_pull(client, windows):
+            center, _ = client.pull()
+            for w in windows:     # pull after commit, still per-window
+                client.commit(step(center, w))
+                center, _ = client.pull()
+        """, rule="staleness-protocol")
+    assert found == []
+
+
+def test_staleness_protocol_branches_are_exclusive():
+    # one commit per mutually exclusive branch is ONE commit per run —
+    # flagging the else-branch would be a false positive (review fix)
+    found = lint("""
+        def branched(client, cond):
+            client.pull()
+            if cond:
+                client.commit(1)
+            else:
+                client.commit(2)
+
+        def handled(client):
+            client.pull()
+            try:
+                client.commit(1)
+            except OSError:
+                client.commit(1)
+        """, rule="staleness-protocol")
+    assert found == []
+
+
+def test_staleness_protocol_commit_after_every_branch_committed():
+    found = lint("""
+        def train(client, cond):
+            client.pull()
+            if cond:
+                client.commit(1)
+            else:
+                client.commit(2)
+            client.commit(3)
+        """, rule="staleness-protocol")
+    assert len(found) == 1 and found[0].line == 8  # stale on EVERY path
+
+
+def test_staleness_protocol_tracks_receivers_separately():
+    found = lint("""
+        def train(a, b):
+            a.pull()
+            b.pull()
+            a.commit(1)
+            b.commit(1)
+            a.commit(2)
+        """, rule="staleness-protocol")
+    assert len(found) == 1
+    assert "`a.commit" in found[0].message
+
+
+# ---------------------------------------------------------------------------
 # suppression: inline pragma + baseline round-trip
 # ---------------------------------------------------------------------------
 
@@ -577,7 +680,7 @@ def test_cli_list_rules(capsys):
     assert dklint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("jit-purity", "lock-discipline", "swallow-guard",
-                "thread-shutdown", "bare-print"):
+                "thread-shutdown", "bare-print", "staleness-protocol"):
         assert rid in out
 
 

@@ -42,6 +42,22 @@ def test_process_workers_converge(ds):
     steps = 2048 // 2 // COMMON["batch_size"]
     commits = 2 * (steps // 4) * COMMON["num_epoch"]
     assert t.ps_stats["num_updates"] == commits
+    # ISSUE 6 satellite: each worker PROCESS wrote its own JSONL under
+    # trace id w<k> and the runner folded it into the trainer's stream —
+    # both halves of every wire span now link (before, only the server
+    # half was recorded for process placement)
+    recs = list(t.metrics.records)
+    hbs = [r for r in recs if r.get("event") == "heartbeat"]
+    assert {h["worker_id"] for h in hbs} == {0, 1}
+    assert len(hbs) == commits
+    worker_commits = [r for r in recs if r.get("event") == "span"
+                      and r.get("name") == "ps.commit"]
+    assert {s["trace_id"] for s in worker_commits} == {"w0", "w1"}
+    commit_ids = {s["span_id"] for s in worker_commits}
+    applies = [r for r in recs if r.get("event") == "span"
+               and r.get("name") == "ps.apply"]
+    linked = [a for a in applies if a.get("parent_span") in commit_ids]
+    assert linked, "no server apply linked back to a worker-process span"
 
 
 def test_process_workers_real_staleness(ds):
