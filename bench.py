@@ -33,7 +33,17 @@ window, and persisting one MERGED client+server obs registry snapshot per
 sweep point beside the BENCH_r*.json files (the ROADMAP telemetry item)
 so runs can diff distributions, not just wall numbers.
 
-Both benches self-check against the committed baseline snapshot named in
+``python bench.py --serve [--requests N] [--concurrency C]
+[--prompt-len P] [--max-new K] [--slots B] [--queue Q]`` runs the
+**decode-service load bench** (ISSUE 7): a localhost continuous-batching
+``ServeServer`` over a small gpt_lm, driven by C closed-loop client
+threads, printing one JSON row with p50/p99 end-to-end +
+time-to-first-token latency, tokens/sec and the load-shed count, and
+persisting the service registry snapshot (SLO histograms + admission
+counters + the zero-pinned ``jit.retraces`` sentinel) to
+``BENCH_SERVE_OBS.json``.
+
+All benches self-check against the committed baseline snapshot named in
 ``OBS_BASELINE.json`` (ISSUE 5): the fresh run's registry snapshot is
 drift-diffed (``distkeras_tpu/obs/drift.py`` — counter ratios, bucket-wise
 PSI, p50/p99 shift) against the previous committed one BEFORE overwriting
@@ -272,6 +282,139 @@ def main():
     }))
 
 
+def bench_serve(requests: int = 32, concurrency: int = 4,
+                prompt_len: int = 12, max_new: int = 16, slots: int = 4,
+                queue: int = 8, out_dir: str = ROOT, wire_version=None,
+                vocab: int = 64, dim: int = 32, heads: int = 2,
+                blocks: int = 1, seq_len: int = 64) -> dict:
+    """Decode-service load bench (ISSUE 7 acceptance): a localhost
+    ``ServeServer`` over a small ``gpt_lm`` and ``concurrency``
+    closed-loop client threads driving ``requests`` generations through
+    the continuous batcher.  One JSON row: p50/p99 end-to-end and
+    time-to-first-token latency, tokens/sec, rejected count.
+
+    The service registry snapshot (SLO histograms, admission counters,
+    and the PRE-CREATED ``jit.compiles``/``jit.retraces`` sentinels — 0
+    must be present, not missing) plus the merged per-client registries
+    persist to ``BENCH_SERVE_OBS.json`` beside the BENCH_r*.json files,
+    drift-checked against the committed baseline BEFORE overwriting it
+    (the same ``OBS_BASELINE.json`` contract as the trainer/PS benches;
+    config-incompatible runs divert to a ``.variant.json`` sidecar)."""
+    from distkeras_tpu.models import zoo
+    from distkeras_tpu.obs import Registry, snapshot_quantile
+    from distkeras_tpu.serve import (DecodeEngine, ServeClient,
+                                     ServeConfig, ServeServer)
+
+    requests, concurrency = int(requests), int(concurrency)
+    if requests < 1 or concurrency < 1:
+        raise ValueError(f"bench_serve needs requests >= 1 and "
+                         f"concurrency >= 1 (got {requests}, "
+                         f"{concurrency})")
+    model = zoo.gpt_lm(vocab_size=vocab, dim=dim, num_heads=heads,
+                       num_blocks=blocks, seq_len=seq_len)
+    variables = model.init(0)
+    cfg = ServeConfig(slots=slots, max_queue=queue,
+                      max_new_tokens=max_new)
+    registry = Registry()
+    engine = DecodeEngine(model, variables, cfg, registry=registry)
+    # compile the whole bucket ladder up front: the measured window is
+    # steady-state serving, and jit.retraces must stay 0 through it
+    engine.warmup()
+
+    regs = [Registry() for _ in range(concurrency)]
+    e2e = [[] for _ in range(concurrency)]
+    ttft = [[] for _ in range(concurrency)]
+    rejected = [0] * concurrency
+    negotiated = [1] * concurrency
+    errors: list = []
+    share = [requests // concurrency + (1 if k < requests % concurrency
+                                        else 0)
+             for k in range(concurrency)]
+
+    def drive(k: int) -> None:
+        try:
+            rng = np.random.default_rng(1000 + k)
+            with ServeClient("127.0.0.1", server.port, registry=regs[k],
+                             wire_version=wire_version) as client:
+                negotiated[k] = client.wire_version
+                for _ in range(share[k]):
+                    prompt = rng.integers(0, vocab, size=(prompt_len,))
+                    t0 = time.perf_counter()
+                    reply = client.generate(prompt, max_new)
+                    if reply.get("ok"):
+                        e2e[k].append(time.perf_counter() - t0)
+                        ttft[k].append(float(reply.get("ttft_s", 0.0)))
+                    elif reply.get("rejected"):
+                        # closed-loop at <= slots+queue outstanding never
+                        # sheds; counted anyway so an open-loop variant
+                        # (concurrency > capacity) reports honestly
+                        rejected[k] += 1
+                    else:
+                        raise RuntimeError(f"generate failed: {reply}")
+        except BaseException as e:  # surfaced after join — never hang
+            errors.append(e)
+
+    t_load0 = time.perf_counter()
+    with ServeServer(engine) as server:
+        threads = [threading.Thread(target=drive, args=(k,),
+                                    name=f"bench-serve-{k}")
+                   for k in range(concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_load0
+    if errors:
+        raise errors[0]
+
+    merged = Registry.merge_snapshots(*[r.snapshot() for r in regs])
+    snap = registry.snapshot()
+    all_e2e = np.asarray([v for part in e2e for v in part])
+    all_ttft = np.asarray([v for part in ttft for v in part])
+    tokens_out = snap["serve.tokens_out"]["value"]
+    row = {
+        "metric": f"serve e2e latency (localhost, gpt_lm d{dim} "
+                  f"T{seq_len}, slots={slots}, conc={concurrency})",
+        "mode": "bench_serve",
+        "requests": requests, "concurrency": concurrency,
+        "prompt_len": prompt_len, "max_new_tokens": max_new,
+        "slots": slots, "max_queue": queue,
+        "e2e_ms_p50": round(float(np.median(all_e2e)) * 1e3, 3)
+        if all_e2e.size else None,
+        "e2e_ms_p99": round(float(np.quantile(all_e2e, 0.99)) * 1e3, 3)
+        if all_e2e.size else None,
+        "ttft_ms_p50": round(float(np.median(all_ttft)) * 1e3, 3)
+        if all_ttft.size else None,
+        "ttft_ms_p99": round(float(np.quantile(all_ttft, 0.99)) * 1e3, 3)
+        if all_ttft.size else None,
+        "queue_wait_ms_p50": round(snapshot_quantile(
+            snap["serve.queue_wait_seconds"], 0.5) * 1e3, 3),
+        "tokens_per_sec": round(tokens_out / wall, 1),
+        "rejected": sum(rejected),
+        "jit_retraces": snap["jit.retraces"]["value"],
+        "wire_version": min(negotiated),
+    }
+    bl_cfg = _baseline_cfg()
+    base_path = _baseline_snapshot_path(bl_cfg, "serve_bench",
+                                        "BENCH_SERVE_OBS.json")
+    obs_doc = {"config": {"mode": "bench_serve",
+                          "requests": requests,
+                          "concurrency": concurrency,
+                          "prompt_len": prompt_len,
+                          "wire_version": min(negotiated),
+                          "model": {"vocab": vocab, "dim": dim,
+                                    "heads": heads, "blocks": blocks,
+                                    "seq_len": seq_len},
+                          **cfg.config_row(seq_len)},
+               "client": merged,
+               "server": snap}
+    snap_path = os.path.join(out_dir, os.path.basename(base_path))
+    row["obs_drift"], snap_path = _persist_obs_snapshot(
+        snap_path, obs_doc, bl_cfg, base_path=base_path)
+    row["snapshot"] = os.path.relpath(snap_path, ROOT)
+    return row
+
+
 def bench_ps(codec: str = "none", windows: int = 50, mb: float = 4.0,
              out_dir: str = ROOT, wire_version=None,
              ps_workers: int = 1) -> dict:
@@ -407,6 +550,21 @@ def _cli(argv=None) -> int:
     ap.add_argument("--ps", action="store_true",
                     help="run the PS-comms microbenchmark instead of the "
                          "trainer headline")
+    ap.add_argument("--serve", action="store_true",
+                    help="run the decode-service load bench instead of "
+                         "the trainer headline")
+    ap.add_argument("--requests", type=int, default=32,
+                    help="bench_serve: total generation requests")
+    ap.add_argument("--concurrency", type=int, default=4,
+                    help="bench_serve: closed-loop client threads")
+    ap.add_argument("--prompt-len", type=int, default=12,
+                    help="bench_serve: prompt length per request")
+    ap.add_argument("--max-new", type=int, default=16,
+                    help="bench_serve: generated tokens per request")
+    ap.add_argument("--slots", type=int, default=4,
+                    help="bench_serve: continuous-batch width")
+    ap.add_argument("--queue", type=int, default=8,
+                    help="bench_serve: admission queue bound")
     ap.add_argument("--codec", default="none",
                     help="bench_ps commit codec: none|int8|bf16|topk<frac>")
     ap.add_argument("--windows", type=int, default=50,
@@ -414,13 +572,24 @@ def _cli(argv=None) -> int:
     ap.add_argument("--mb", type=float, default=4.0,
                     help="bench_ps synthetic center size in MB")
     ap.add_argument("--wire", type=int, default=None, choices=(1, 2),
-                    help="bench_ps: pin the frame format (default: "
-                         "negotiate v2)")
+                    help="bench_ps / bench_serve: pin the frame format "
+                         "(default: negotiate v2)")
     ap.add_argument("--ps-workers", default="1",
                     help="bench_ps: comma-separated concurrent-client "
                          "sweep points (e.g. 1,2,4); one JSON row and one "
                          "merged registry snapshot per point")
     args = ap.parse_args(argv)
+    if args.ps and args.serve:
+        ap.error("--ps and --serve are mutually exclusive")
+    if args.serve:
+        if args.requests < 1 or args.concurrency < 1:
+            ap.error("--requests and --concurrency must be >= 1")
+        print(json.dumps(bench_serve(
+            requests=args.requests, concurrency=args.concurrency,
+            prompt_len=args.prompt_len, max_new=args.max_new,
+            slots=args.slots, queue=args.queue,
+            wire_version=args.wire)))
+        return 0
     if args.ps:
         try:
             points = [int(p) for p in str(args.ps_workers).split(",") if p]

@@ -111,7 +111,9 @@ def _is_trace_transform(node: ast.AST) -> bool:
 class JitPurityRule(Rule):
     id = "jit-purity"
     description = ("side effects / host syncs inside jit-traced functions "
-                   "(run once at trace time, then baked into the program)")
+                   "(run once at trace time, then baked into the program; "
+                   "functions CALLED from a traced body are traced too — "
+                   "followed one call-edge level)")
 
     def check(self, ctx: FileContext) -> List[Finding]:
         defs: Dict[str, List[ast.AST]] = {}
@@ -137,6 +139,19 @@ class JitPurityRule(Rule):
                 if isinstance(arg, ast.Name):
                     for fn in defs.get(arg.id, []):
                         mark(fn)
+
+        # one-level call-edge following (ISSUE 7, carried ROADMAP item):
+        # a function invoked BY NAME from a traced body runs at trace time
+        # too — its clock/RNG/sync violations bake into the program just
+        # the same.  One level only: deeper chains trade signal for noise
+        # (and same-name resolution is already heuristic); attribute
+        # calls (self.f(), module.f()) stay unresolved by design.
+        for fn in list(traced):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Name):
+                    for callee in defs.get(node.func.id, []):
+                        mark(callee)
 
         findings: List[Finding] = []
         flagged: Set[Tuple[int, int]] = set()

@@ -45,12 +45,48 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..obs.profile import RetraceSentinel
 from .layers import Layer
 
 #: compiled decode runners kept per model (LRU): eval loops over many
 #: distinct (prompt_len, num_steps, ...) shapes would otherwise retain one
 #: executable EACH for the model's lifetime (ADVICE r3)
 _RUNNER_CACHE_MAX = 16
+
+# -- recompilation accounting (ISSUE 7) -------------------------------------
+# One sentinel per decode entry point, observed by (model identity, runner
+# cache key) — the key bakes in everything the compiled scan specializes
+# on (shapes AND values like temperature), so decode recompiles count into
+# ``jit.compiles``/``jit.retraces`` like every other jit entry point.
+# ``warn=False``: many keys are a LEGITIMATE workload here (eval sweeps,
+# decode_bench's config matrix) — the counters still feed the drift gate,
+# and the serve engine's per-bucket sentinels do warn, because a serving
+# bucket that re-traces is a real bug.
+
+_SENTINELS: dict = {}
+_SENTINEL_REGISTRY: list = [None]
+
+
+def set_decode_registry(registry) -> None:
+    """Route the decode entry points' ``jit.compiles``/``jit.retraces``
+    counters into ``registry`` for this process (None restores the
+    default registry) — how ``scripts/decode_bench.py`` and tests scope
+    decode recompile accounting to their own snapshot."""
+    _SENTINEL_REGISTRY[0] = registry
+
+
+def _decode_registry():
+    return _SENTINEL_REGISTRY[0]
+
+
+def _observe_decode(entry: str, model, key) -> None:
+    s = _SENTINELS.get(entry)
+    if s is None:
+        s = _SENTINELS[entry] = RetraceSentinel(
+            f"decode.{entry}", registry=_decode_registry, warn=False)
+    # id(model) scopes keys per live model instance (in-process counting
+    # only — two models legitimately compile the same key once each)
+    s.observe_key((id(model), key))
 
 # plain Python float: a module-level jnp scalar would initialize the XLA
 # backend at import time, breaking jax.distributed.initialize for any
@@ -210,6 +246,7 @@ def generate_tokens(model, variables, prompt, num_steps: int,
            None if top_k is None else int(top_k),
            None if top_p is None else float(top_p),
            None if eos_id is None else int(eos_id), ragged)
+    _observe_decode("generate_tokens", model, key)
     runners, run = _cached_runner(model, key)
 
     if run is None:
@@ -363,6 +400,7 @@ def generate_beam(model, variables, prompt, num_steps: int,
     key = ("beam", p, num_steps, k_beams, cache is not None, b,
            None if eos_id is None else int(eos_id), float(length_penalty),
            ragged)
+    _observe_decode("generate_beam", model, key)
     runners, run = _cached_runner(model, key)
 
     if run is None:

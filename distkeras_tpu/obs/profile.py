@@ -99,12 +99,24 @@ class RetraceSentinel:
     attached after construction still receives the counts), or None for
     the process-wide default.  Retraces log once per signature (warning —
     they are the regression this sentinel exists to catch) and, with a
-    ``sink``, emit a ``retrace`` record into the JSONL stream."""
+    ``sink``, emit a ``retrace`` record into the JSONL stream.
 
-    def __init__(self, name: str, registry=None, sink=None):
+    ``observe_key`` (ISSUE 7) is the variant for entry points that manage
+    their own compiled-program cache keyed by MORE than arg shapes (the
+    decode runners in ``models.generation``: temperature, top-k, beam
+    width ... all bake into the program): the caller's hashable cache key
+    IS the signature, so value-level program changes the shape signature
+    cannot see still count.  ``warn=False`` keeps the counters but
+    silences the once-per-signature log — for entry points where many
+    signatures are a legitimate workload (offline eval/bench sweeps),
+    not a regression."""
+
+    def __init__(self, name: str, registry=None, sink=None,
+                 warn: bool = True):
         self.name = name
         self._registry = registry
         self.sink = sink
+        self.warn = bool(warn)
         self._sigs: dict = {}   # signature -> digest
         self._lock = threading.Lock()
 
@@ -117,7 +129,15 @@ class RetraceSentinel:
         return len(self._sigs)
 
     def observe(self, args: Any) -> str:
-        sig = tree_signature(args)
+        return self._observe_sig(tree_signature(args))
+
+    def observe_key(self, key: Any) -> str:
+        """Count a call by the caller's own hashable program-cache key
+        (same cold/warm/retrace semantics as ``observe``) — for entry
+        points whose compiled program depends on more than arg shapes."""
+        return self._observe_sig(("key", key))
+
+    def _observe_sig(self, sig: Any) -> str:
         with self._lock:
             if sig in self._sigs:
                 return "warm"
@@ -132,10 +152,11 @@ class RetraceSentinel:
         reg.counter("jit.retraces").inc()
         # once per signature by construction: a signature enters _sigs
         # exactly once, and only that insertion reaches this path
-        get_logger(_LOG).warning(
-            "%s: retrace #%d — new arg signature %s (shapes/dtypes changed "
-            "since the cold compile; steady-state steps should never "
-            "re-trace)", self.name, n_retrace, digest)
+        if self.warn:
+            get_logger(_LOG).warning(
+                "%s: retrace #%d — new arg signature %s (shapes/dtypes "
+                "changed since the cold compile; steady-state steps should "
+                "never re-trace)", self.name, n_retrace, digest)
         if self.sink is not None:
             self.sink.log("retrace", entry=self.name, signature=digest,
                           retraces=n_retrace)

@@ -38,14 +38,14 @@ owns that failure, as in the reference's Spark task retry).
 from __future__ import annotations
 
 import contextlib
-import os
 import time
 from typing import Any, Optional
 
 from ..obs import TIME_BUCKETS, Registry, default_registry
 from ..obs.spans import SpanTracer
 from . import codecs
-from .networking import WIRE_VERSION, connect, recv_msg, send_msg
+from .networking import (client_handshake, connect, pinned_wire_version,
+                         recv_msg, send_msg)
 
 
 class PSClient:
@@ -76,9 +76,7 @@ class PSClient:
         self.tracer = tracer
         #: ``None`` negotiates (the default); ``1`` pins the legacy wire —
         #: also reachable via ``DKTPU_WIRE=1`` for whole-process opt-out
-        if wire_version is None and os.environ.get("DKTPU_WIRE") == "1":
-            wire_version = 1
-        self._want_version = wire_version
+        self._want_version = pinned_wire_version(wire_version)
         self.wire_version = 1
         #: client-side center cache: (center_tree, server_update_counter)
         self._last_pull: Optional[tuple] = None
@@ -86,21 +84,12 @@ class PSClient:
         self._handshake()
 
     def _handshake(self) -> None:
-        """Negotiate the wire format for this connection.  The hello is
-        always v1-framed (any server parses it); current servers reply
-        with the agreed version, old ones with an unknown-action error —
-        that failure IS the negotiation result: v1."""
-        self.wire_version = 1
-        want = self._want_version if self._want_version is not None \
-            else WIRE_VERSION
-        if want < 2:
-            return
-        send_msg(self.sock, {"action": "hello", "worker_id": self.worker_id,
-                             "versions": list(range(1, want + 1))},
-                 registry=self.registry)
-        resp = recv_msg(self.sock, registry=self.registry)
-        if resp.get("ok"):
-            self.wire_version = int(resp.get("version", 1))
+        """Negotiate the wire format for this connection (the shared
+        ``networking.client_handshake`` seam — serve clients run the same
+        exchange)."""
+        self.wire_version = client_handshake(
+            self.sock, registry=self.registry, worker_id=self.worker_id,
+            want=self._want_version)
 
     def reconnect(self) -> None:
         """Drop the (possibly broken) connection and dial again (the
@@ -136,6 +125,14 @@ class PSClient:
             resp = recv_msg(self.sock, registry=self.registry)
         self._h_rtt.observe(time.perf_counter() - t0)
         return resp
+
+    @staticmethod
+    def _raise_on_error(what: str, resp: dict) -> None:
+        """Server error replies ({"ok": False, "error": ...} from a
+        failed dispatch) raise instead of being misread as data."""
+        if isinstance(resp, dict) and resp.get("error") is not None:
+            raise RuntimeError(f"ps {what} failed on the server: "
+                               f"{resp['error']}")
 
     def _span(self, name: str):
         """``ps.pull``/``ps.commit`` client span, or a no-op scope when no
@@ -177,6 +174,7 @@ class PSClient:
             have = self._last_pull[1] if self._last_pull is not None \
                 else None
             resp = self._rpc(pull_msg(have), retry=True)
+            self._raise_on_error("pull", resp)
             updates = int(resp["updates"])
             if resp.get("unchanged"):
                 if self._last_pull is not None:
@@ -186,6 +184,7 @@ class PSClient:
                 # reconnect dropped it, but the retry resent the stale
                 # ``have``): ask again unconditionally for the full center
                 resp = self._rpc(pull_msg(), retry=True)
+                self._raise_on_error("pull", resp)
                 updates = int(resp["updates"])
             self._last_pull = (resp["center"], updates)
             return resp["center"], updates
@@ -218,6 +217,10 @@ class PSClient:
             if last_update is not None:
                 msg["last_update"] = int(last_update)
             resp = self._rpc(msg)
+            # a server-side apply failure answers {"ok": False, "error"}
+            # (it did NOT apply the delta) — that must surface as a
+            # failure to the worker's retry policy, never as success
+            self._raise_on_error("commit", resp)
             return not resp.get("dropped", False)
 
     def stats(self) -> dict:

@@ -42,10 +42,11 @@ attempts that failed-and-retried.
 
 from __future__ import annotations
 
+import os
 import socket
 import struct
 import time
-from typing import Any, List, Optional, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 from ..obs import default_registry
 from ..utils import serde
@@ -92,6 +93,50 @@ def connect(host: str, port: int, timeout: Optional[float] = 30.0,
             reg.counter("net.connect_retries").inc()
             time.sleep(retry_delay)
     raise ConnectionError(f"cannot connect to {host}:{port}: {last}")
+
+
+# ---------------------------------------------------------------------------
+# hello negotiation (ISSUE 7: the seam the PS stack and the serve stack
+# share — one definition of "which frame format may this connection use")
+# ---------------------------------------------------------------------------
+
+def pinned_wire_version(want: Optional[int]) -> Optional[int]:
+    """Resolve a caller's wire pin: an explicit ``want`` wins; otherwise
+    ``DKTPU_WIRE=1`` pins the whole process to the legacy frame."""
+    if want is None and os.environ.get("DKTPU_WIRE") == "1":
+        return 1
+    return want
+
+
+def choose_wire_version(offered: Optional[Sequence[int]],
+                        max_wire_version: int = WIRE_VERSION) -> int:
+    """Server side of the hello handshake: the newest offered format this
+    end also speaks (1 when nothing admissible was offered — v1 is the
+    frozen floor every peer parses)."""
+    versions = [int(v) for v in (offered or [1])]
+    return max(v for v in versions + [1] if v <= int(max_wire_version))
+
+
+def client_handshake(sock: socket.socket, registry=None,
+                     worker_id: Optional[int] = None,
+                     want: Optional[int] = None) -> int:
+    """Client side of the hello handshake; returns the negotiated wire
+    version for this connection.  The hello itself is always v1-framed
+    (any server parses it); current servers answer with the agreed
+    version, old ones with an unknown-action error — that failure IS the
+    negotiation result: v1."""
+    want = pinned_wire_version(want)
+    want = WIRE_VERSION if want is None else int(want)
+    if want < 2:
+        return 1
+    msg: dict = {"action": "hello", "versions": list(range(1, want + 1))}
+    if worker_id is not None:
+        msg["worker_id"] = int(worker_id)
+    send_msg(sock, msg, registry=registry)
+    resp = recv_msg(sock, registry=registry)
+    if resp.get("ok"):
+        return int(resp.get("version", 1))
+    return 1
 
 
 # ---------------------------------------------------------------------------

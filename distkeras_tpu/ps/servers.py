@@ -32,12 +32,13 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from ..obs import COUNT_BUCKETS, TIME_BUCKETS, Registry, StragglerDetector
+from ..obs.logging import get_logger
 from ..obs.spans import SpanTracer
 from ..parallel.sync import _inexact, tmap as _tree_map
 from ..utils import native
 from . import codecs
-from .networking import (WIRE_VERSION, pack_msg, recv_msg, send_msg,
-                         send_packed)
+from .networking import (WIRE_VERSION, choose_wire_version, pack_msg,
+                         recv_msg, send_msg, send_packed)
 
 Tree = Any
 
@@ -347,6 +348,12 @@ class SocketParameterServer:
                                  daemon=True, name="ps-conn")
             t.start()
             with self._conn_lock:
+                # prune finished handlers so a long-lived server (one
+                # short connection per obsview poll / worker retry) never
+                # accumulates dead Thread objects; index 0 stays the
+                # accept thread
+                self._threads[1:] = [h for h in self._threads[1:]
+                                     if h.is_alive()]
                 self._threads.append(t)
 
     def _center_payload(self, center, updates: int, ver: int):
@@ -416,9 +423,8 @@ class SocketParameterServer:
                 self._g_inflight.inc()
                 try:
                     if action == "hello":
-                        offered = [int(v) for v in msg.get("versions", [1])]
-                        ver = max(v for v in offered + [1]
-                                  if v <= self.max_wire_version)
+                        ver = choose_wire_version(msg.get("versions"),
+                                                  self.max_wire_version)
                         # the reply itself stays v1-framed: the client
                         # switches only after reading it
                         send_msg(conn, {"ok": True, "version": ver},
@@ -468,6 +474,20 @@ class SocketParameterServer:
                         send_msg(conn, {"ok": False,
                                         "error": f"unknown action {action!r}"},
                                  registry=reg, version=ver)
+                except (ConnectionError, OSError):
+                    return  # peer gone mid-reply; nothing to answer
+                except Exception as e:
+                    # a malformed FIELD (bad versions list, undecodable
+                    # codec stub) answers like any bad request instead of
+                    # killing the handler and dropping the worker's
+                    # connection replyless
+                    get_logger("ps.server").warning("action %r failed: %s",
+                                                    action, e)
+                    try:
+                        send_msg(conn, {"ok": False, "error": str(e)},
+                                 registry=reg, version=ver)
+                    except (ConnectionError, OSError):
+                        return
                 finally:
                     self._g_inflight.dec()
         finally:

@@ -1,0 +1,29 @@
+"""Online inference serving — the continuous-batching decode service
+(ISSUE 7 tentpole).
+
+The reference ships distributed inference as a first-class layer
+(``ModelPredictor`` batched jit inference); this package is its ONLINE
+counterpart for the ``gpt_lm`` family: a request queue + continuous
+batcher over the ragged KV-cached decode (``models.generation`` — per-row
+cache positions let a new request join a running batch slot as finished
+rows retire), served over the v2 zero-copy tensor wire
+(``ps.networking``), with per-request SLO histograms, admission control,
+graceful drain, and a live ``stats`` RPC the same ``obs`` tooling reads.
+
+Layers:
+
+* ``config``  — ``ServeConfig``: batch slots, prefill length buckets,
+  sampling controls, admission bounds.
+* ``engine``  — ``DecodeEngine``: the scheduler/batcher and its three
+  compiled-per-bucket programs (join = prefill + scatter into a slot,
+  step = one token for every active slot), each behind its own retrace
+  sentinel so steady-state serving is provably ``jit.retraces == 0``.
+* ``server``  — ``ServeServer``: TCP front-end speaking the PS wire
+  framing (hello/generate/stats/drain/stop) with v1/v2 negotiation.
+* ``client``  — ``ServeClient``: the worker-side connection.
+"""
+
+from .config import ServeConfig  # noqa: F401
+from .engine import DecodeEngine, ServeRejected, ServeRequest  # noqa: F401
+from .server import ServeServer  # noqa: F401
+from .client import ServeClient  # noqa: F401

@@ -1,0 +1,125 @@
+"""Client for the decode service (ISSUE 7) — one persistent connection
+speaking the shared PS wire framing, hello-negotiated v1/v2 per
+connection exactly like ``PSClient`` (the ``networking.client_handshake``
+seam).
+
+``generate()`` returns the server's reply dict verbatim — ``ok`` True
+with an int32 ``tokens`` array (zero-copy on v2 connections) and the
+server-side timings, or ``ok`` False with either ``rejected`` (the
+admission controller load-shed — an OPERATIONAL outcome the caller
+handles, not an exception) or ``error`` (a malformed request).  The
+client observes its own SLO view: ``serve.client.e2e_seconds`` per
+generate round-trip, ``serve.client.requests`` / ``serve.client.rejected``
+counters — the load-generator side of ``bench.py --serve`` merges these
+per-thread registries into the persisted snapshot.
+
+``stats()`` transparently reconnects-and-retries once (idempotent read);
+``generate`` does NOT auto-retry — the server may have admitted (and be
+decoding) the request even though the connection died, and a resend
+would double-spend slots.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ..obs import TIME_BUCKETS, Registry, default_registry
+from ..ps.networking import (client_handshake, connect, pinned_wire_version,
+                             recv_msg, send_msg)
+
+
+class ServeClient:
+    def __init__(self, host: str, port: int,
+                 registry: Optional[Registry] = None,
+                 wire_version: Optional[int] = None):
+        self.host = host
+        self.port = port
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self._h_e2e = self.registry.histogram("serve.client.e2e_seconds",
+                                              TIME_BUCKETS)
+        self._c_requests = self.registry.counter("serve.client.requests")
+        self._c_rejected = self.registry.counter("serve.client.rejected")
+        self._c_reconnects = self.registry.counter(
+            "serve.client.reconnects")
+        #: ``None`` negotiates; ``1`` pins legacy (also via DKTPU_WIRE=1)
+        self._want_version = pinned_wire_version(wire_version)
+        self.sock = connect(host, port)
+        self.wire_version = client_handshake(self.sock,
+                                             registry=self.registry,
+                                             want=self._want_version)
+
+    def reconnect(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.sock = connect(self.host, self.port)
+        self._c_reconnects.inc()
+        self.wire_version = client_handshake(self.sock,
+                                             registry=self.registry,
+                                             want=self._want_version)
+
+    def _rpc(self, msg: dict, retry: bool = False) -> Any:
+        try:
+            send_msg(self.sock, msg, registry=self.registry,
+                     version=self.wire_version)
+            return recv_msg(self.sock, registry=self.registry)
+        except (ConnectionError, OSError):
+            if not retry:
+                raise
+            self.reconnect()
+            send_msg(self.sock, msg, registry=self.registry,
+                     version=self.wire_version)
+            return recv_msg(self.sock, registry=self.registry)
+
+    def generate(self, prompt, max_new_tokens: Optional[int] = None) -> dict:
+        """One generation round-trip; blocks until the server finishes
+        (or load-sheds) the request.  Returns the reply dict — check
+        ``reply["ok"]``; on success ``reply["tokens"]`` holds the
+        generated int32 ids."""
+        msg: dict = {"action": "generate",
+                     "prompt": np.asarray(prompt, np.int32).reshape(-1)}
+        if max_new_tokens is not None:
+            msg["max_new_tokens"] = int(max_new_tokens)
+        self._c_requests.inc()
+        t0 = time.perf_counter()
+        reply = self._rpc(msg)
+        self._h_e2e.observe(time.perf_counter() - t0)
+        if not reply.get("ok") and reply.get("rejected"):
+            self._c_rejected.inc()
+        return reply
+
+    def stats(self) -> dict:
+        """Poll the service's live telemetry (registry snapshot + queue/
+        slot state) — no decode work, safe under load."""
+        return self._rpc({"action": "stats"}, retry=True)
+
+    def drain(self, timeout_s: Optional[float] = None) -> dict:
+        """Ask the server to drain gracefully (idempotent)."""
+        msg: dict = {"action": "drain"}
+        if timeout_s is not None:
+            msg["timeout_s"] = float(timeout_s)
+        return self._rpc(msg)
+
+    def close(self) -> None:
+        try:
+            send_msg(self.sock, {"action": "stop"}, registry=self.registry,
+                     version=self.wire_version)
+            recv_msg(self.sock, registry=self.registry)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

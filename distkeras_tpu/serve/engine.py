@@ -1,0 +1,589 @@
+"""Continuous-batching decode engine (ISSUE 7 tentpole).
+
+One decode state for ``slots`` concurrent requests — buffer (B, T),
+KV cache (B rows), per-row position/logits — advanced one token per
+``step`` for every ACTIVE row, exactly the ragged per-row read/write
+machinery ``models.generation`` already compiles (one-hot position
+writes, (B,) cache positions).  A new request does not wait for the
+batch to finish: a **join** program prefills the prompt at its length
+bucket and scatters the row (buffer, padded cache, position, first-token
+logits) into a retired slot while the other rows keep decoding.
+
+Three compiled-program families, all static-shaped by construction:
+
+* ``serve.join.l<L>`` — per prefill bucket L: single-row prefill of the
+  (1, L) padded prompt + one-hot scatter into slot ``row``.
+* ``serve.step`` — sample every active row's next token from its carried
+  logits, write it at the row's own position, one cached decode forward
+  for the next position's logits.  Inactive rows are masked no-ops.
+* Each program sits behind its own ``RetraceSentinel``
+  (``jit.compiles``/``jit.retraces`` in the service registry) — after
+  ``warmup()`` compiles the full bucket ladder, steady-state serving is
+  provably ``jit.retraces == 0`` (the drift-gated serving contract).
+
+Scheduling is host-side and single-threaded: one decode thread owns the
+device state and the slot table; ``submit()`` (any thread) only touches
+the bounded admission queue.  SLO surface, all in the service registry:
+``serve.queue_wait_seconds`` (submit -> slot), ``serve.ttft_seconds``
+(submit -> first token), ``serve.per_token_seconds`` (each emitted
+token's step wall), ``serve.e2e_seconds`` (submit -> done),
+``serve.step_seconds``, counters ``serve.requests`` / ``serve.admitted``
+/ ``serve.completed`` / ``serve.tokens_out`` / ``serve.rejected`` (split
+by reason), gauges ``serve.queue_depth`` / ``serve.active_slots``.
+
+Admission control: a full queue (or a draining engine) load-sheds with
+``ServeRejected`` — every request either completes or is recorded under
+``serve.rejected``; nothing drops silently (the graceful-drain
+contract, including hard-stop aborts).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..obs import Registry, TIME_BUCKETS
+from ..obs.logging import get_logger
+from ..obs.profile import RetraceSentinel
+from ..models.generation import _filter_logits, _model_cache
+from .config import ServeConfig
+
+_LOG = "serve.engine"
+
+#: decode-thread wait quantum while idle (seconds) — submissions notify
+#: the condition, so this only bounds shutdown latency
+_IDLE_WAIT_S = 0.05
+
+
+class ServeRejected(Exception):
+    """A request the admission controller load-shed (queue full /
+    draining / aborted by a hard stop).  ``reason`` names which."""
+
+    def __init__(self, reason: str):
+        super().__init__(f"request rejected: {reason}")
+        self.reason = reason
+
+
+class ServeRequest:
+    """One in-flight generation: the handle ``submit()`` returns.
+
+    ``wait(timeout)`` blocks until completion; ``result()`` returns the
+    GENERATED token ids (eos included when sampled) as int32, raising
+    ``ServeRejected`` if the engine aborted the request mid-flight."""
+
+    __slots__ = ("prompt", "length", "max_new", "tokens", "error",
+                 "submit_t", "admit_t", "first_token_t", "done_t",
+                 "_done")
+
+    def __init__(self, prompt: np.ndarray, max_new: int):
+        self.prompt = prompt
+        self.length = int(prompt.shape[0])
+        self.max_new = int(max_new)
+        self.tokens: list = []
+        self.error: Optional[str] = None
+        self.submit_t = time.perf_counter()
+        self.admit_t: Optional[float] = None
+        self.first_token_t: Optional[float] = None
+        self.done_t: Optional[float] = None
+        self._done = threading.Event()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._done.wait(timeout):
+            raise TimeoutError("request not complete")
+        if self.error is not None:
+            raise ServeRejected(self.error)
+        return np.asarray(self.tokens, np.int32)
+
+
+class _Slot:
+    """Decode-thread-private per-row bookkeeping (no locking: one owner)."""
+
+    __slots__ = ("request",)
+
+    def __init__(self):
+        self.request: Optional[ServeRequest] = None
+
+
+class DecodeEngine:
+    """The scheduler/batcher.  ``start()`` spawns the decode thread;
+    ``submit()`` is thread-safe; ``drain()`` stops admission and waits
+    for in-flight work; ``stop()`` is drain + shutdown (hard stop after
+    ``drain_timeout_s``, aborted requests recorded as rejections)."""
+
+    def __init__(self, model, variables, config: Optional[ServeConfig] = None,
+                 registry: Optional[Registry] = None):
+        import jax
+
+        self.model = model
+        self.config = config if config is not None else ServeConfig()
+        self.registry = registry if registry is not None else Registry()
+        self._t = int(model.input_shape[0])
+        self._b = int(self.config.slots)
+        self._buckets = self.config.resolved_buckets(self._t)
+        if self.config.max_new_tokens >= self._t:
+            raise ValueError(
+                f"max_new_tokens {self.config.max_new_tokens} must be < "
+                f"the model's seq_len {self._t}")
+        cache = _model_cache(model, self._b)
+        if cache is None:
+            raise ValueError(
+                "the serve engine needs the KV-cached decode path "
+                "(init_cache protocol, no mesh-attached attention, no "
+                "time-mixing layer without a decode rule) — "
+                "models.generation documents the contract")
+        out_shape = model.output_shape
+        self._vocab = int(out_shape[-1])
+
+        #: variables live on device once — per-call host->device transfer
+        #: of the whole parameter tree would dwarf a decode step
+        self._variables = jax.tree_util.tree_map(jax.numpy.asarray,
+                                                 variables)
+
+        # device-resident decode state (owned by the decode thread after
+        # start(); construction happens-before the thread)
+        self._init_state(cache)
+
+        # compiled programs + their retrace sentinels (one per entry
+        # point: every bucket join is its own program, so each compiles
+        # exactly once and any later signature change is a real retrace)
+        self._step_fn = None
+        self._join_fns: dict = {}
+        self._sentinels: dict = {}
+        # pre-create the sentinel counters so a snapshot taken before any
+        # traffic carries an explicit 0 (a missing metric is only a drift
+        # NOTE; a present 0 -> 1 jump is gated)
+        self.registry.counter("jit.compiles")
+        self.registry.counter("jit.retraces")
+
+        reg = self.registry
+        self._h_queue_wait = reg.histogram("serve.queue_wait_seconds",
+                                           TIME_BUCKETS)
+        self._h_ttft = reg.histogram("serve.ttft_seconds", TIME_BUCKETS)
+        self._h_per_token = reg.histogram("serve.per_token_seconds",
+                                          TIME_BUCKETS)
+        self._h_e2e = reg.histogram("serve.e2e_seconds", TIME_BUCKETS)
+        self._h_step = reg.histogram("serve.step_seconds", TIME_BUCKETS)
+        self._h_join = reg.histogram("serve.join_seconds", TIME_BUCKETS)
+        self._c_requests = reg.counter("serve.requests")
+        self._c_admitted = reg.counter("serve.admitted")
+        self._c_completed = reg.counter("serve.completed")
+        self._c_tokens = reg.counter("serve.tokens_out")
+        self._c_steps = reg.counter("serve.steps")
+        self._c_joins = reg.counter("serve.joins")
+        self._c_promotions = reg.counter("serve.promotions")
+        self._c_rejected = reg.counter("serve.rejected")
+        self._c_rej_full = reg.counter("serve.rejected_queue_full")
+        self._c_rej_drain = reg.counter("serve.rejected_draining")
+        self._c_rej_abort = reg.counter("serve.rejected_aborted")
+        self._g_queue = reg.gauge("serve.queue_depth")
+        self._g_active = reg.gauge("serve.active_slots")
+
+        #: admission queue + flags — the ONLY state shared across threads;
+        #: every touch goes through _lock (slot table and device state are
+        #: decode-thread-private)
+        self._queue: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._draining = False
+        self._pending_variables = None
+        self._stop_evt = threading.Event()
+        self._idle_evt = threading.Event()
+        self._idle_evt.set()
+        self._slots = [_Slot() for _ in range(self._b)]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- device state -------------------------------------------------------
+    def _init_state(self, cache=None):
+        import jax
+        import jax.numpy as jnp
+
+        b, t = self._b, self._t
+        self._buf = jnp.zeros((b, t), jnp.int32)
+        self._cache = cache if cache is not None \
+            else _model_cache(self.model, b)
+        self._pos = jnp.zeros((b,), jnp.int32)
+        self._logits = jnp.zeros((b, self._vocab), jnp.float32)
+        self._rng = jax.random.PRNGKey(int(self.config.seed))
+
+    # -- compiled programs --------------------------------------------------
+    def _sentinel(self, name: str) -> RetraceSentinel:
+        s = self._sentinels.get(name)
+        if s is None:
+            s = self._sentinels[name] = RetraceSentinel(
+                f"serve.{name}", registry=lambda: self.registry)
+        return s
+
+    def _join_fn(self, bucket: int):
+        """The bucket's compiled join: single-row prefill of the (1, L)
+        padded prompt + scatter into slot ``row`` of the batch state."""
+        import jax
+        import jax.numpy as jnp
+
+        fn = self._join_fns.get(bucket)
+        if fn is not None:
+            return fn
+        model, b, t, length_cap = self.model, self._b, self._t, bucket
+
+        def _join(variables, buf, cache, pos, logits, prompt, length, row):
+            params, state = variables["params"], variables["state"]
+            cache1 = model.layer.init_cache(1, (length_cap,))
+            y, cache1 = model.layer.apply_prefill(params, state, prompt,
+                                                  cache1)
+            sel = jax.nn.one_hot(length - 1, length_cap, dtype=y.dtype)
+            logits0 = jnp.einsum("btv,t->bv", y, sel)      # (1, V)
+
+            oh = jax.nn.one_hot(row, b)                     # (B,) float
+            is_row = jnp.arange(b) == row
+
+            def scatter(c, c1):
+                pad = [(0, 0)] * c1.ndim
+                pad[1] = (0, c.shape[1] - c1.shape[1])
+                c1p = jnp.pad(c1, pad).astype(c.dtype)
+                ohx = oh.reshape((b,) + (1,) * (c.ndim - 1)).astype(c.dtype)
+                return c * (1 - ohx) + c1p * ohx
+
+            cache = jax.tree_util.tree_map(scatter, cache, cache1)
+            prow = jnp.zeros((t,), jnp.int32).at[:length_cap].set(prompt[0])
+            ohi = oh.astype(jnp.int32)[:, None]
+            buf = buf * (1 - ohi) + prow[None, :] * ohi
+            pos = jnp.where(is_row, length, pos)
+            logits = jnp.where(is_row[:, None],
+                               logits0.astype(logits.dtype), logits)
+            return buf, cache, pos, logits
+
+        fn = self._join_fns[bucket] = jax.jit(_join)
+        return fn
+
+    def _build_step(self):
+        """One continuous-batching decode step: every ACTIVE row samples
+        its next token from the carried logits, writes it at its own
+        position, and runs one cached decode forward; inactive rows are
+        masked no-ops (their state is replaced wholesale at join)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._step_fn is not None:
+            return self._step_fn
+        model, t = self.model, self._t
+        temperature = float(self.config.temperature)
+        top_k, top_p = self.config.top_k, self.config.top_p
+
+        def _step(variables, buf, cache, pos, logits, active, rng):
+            params, state = variables["params"], variables["state"]
+            if temperature > 0.0:
+                rng, sub = jax.random.split(rng)
+                filtered = _filter_logits(logits / temperature, top_k,
+                                          top_p)
+                nxt = jax.random.categorical(sub, filtered, axis=-1)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            nxt = nxt.astype(jnp.int32)
+            mask = active.astype(jnp.int32)
+            w = jax.nn.one_hot(pos, t, dtype=jnp.int32) * mask[:, None]
+            buf = buf * (1 - w) + nxt[:, None] * w
+            # clamp retired rows' positions into range: their decode
+            # output is discarded, but the cache scatter must stay
+            # in-bounds
+            pos_dec = jnp.minimum(pos, t - 1)
+            logits2, cache = model.layer.apply_decode(params, state, nxt,
+                                                      cache, pos_dec)
+            logits = jnp.where(active[:, None],
+                               logits2.astype(logits.dtype), logits)
+            pos = pos + mask
+            return buf, cache, pos, logits, rng, nxt
+
+        self._step_fn = jax.jit(_step)
+        return self._step_fn
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "DecodeEngine":
+        if self._thread is not None:
+            raise RuntimeError("engine already started")
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-decode")
+        self._thread.start()
+        return self
+
+    def warmup(self) -> "DecodeEngine":
+        """Compile the full program ladder (every bucket's join + the
+        step) against throwaway inputs, then reset the decode state —
+        after this, serving traffic never cold-compiles and any retrace
+        is a real bucketing bug (``jit.retraces`` stays 0).  Call before
+        ``start()`` (or at least before admitting traffic)."""
+        import jax
+
+        state = (self._buf, self._cache, self._pos, self._logits)
+        for bucket in self._buckets:
+            prompt = np.zeros((1, bucket), np.int32)
+            # observed args must mirror _admit's exactly — a differing
+            # signature here would make the first real join a "retrace"
+            args = state + (prompt, np.int32(1), np.int32(0))
+            self._sentinel(f"join.l{bucket}").observe(args)
+            state = self._join_fn(bucket)(self._variables, *args)
+        active = np.zeros((self._b,), bool)
+        args = state + (active, self._rng)
+        self._sentinel("step").observe(args)
+        out = self._build_step()(self._variables, *args)
+        jax.block_until_ready(out[0])
+        self._init_state()
+        return self
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        """Shut the engine down.  ``drain=True`` (default) completes
+        queued + in-flight requests first (bounded by ``timeout`` /
+        ``drain_timeout_s``); anything still outstanding afterwards —
+        or everything, with ``drain=False`` — is aborted with a recorded
+        rejection."""
+        if drain:
+            self.drain(timeout=timeout)
+        else:
+            with self._lock:
+                self._draining = True
+        self._stop_evt.set()
+        with self._lock:
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self._abort_outstanding("aborted: engine stopped")
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop admitting, wait for queue + slots to empty.  Returns True
+        when fully drained within the timeout."""
+        with self._lock:
+            self._draining = True
+            self._work.notify_all()
+        timeout = self.config.drain_timeout_s if timeout is None \
+            else float(timeout)
+        return self._idle_evt.wait(timeout)
+
+    def _abort_outstanding(self, reason: str) -> None:
+        """Fail every request still queued or in a slot (post-stop): each
+        is recorded under ``serve.rejected`` — the no-silent-drop
+        contract.  The queue drains under the lock (atomic against a
+        concurrent pop); the slot table is touched only when the decode
+        thread is THIS thread (the crash handler) or provably dead — a
+        join that timed out must not race slot writes against a decode
+        thread still finishing a long step."""
+        with self._lock:
+            stranded = list(self._queue)
+            self._queue.clear()
+            self._g_queue.set(0)
+        own_slots = self._thread is None \
+            or self._thread is threading.current_thread() \
+            or not self._thread.is_alive()
+        if own_slots:
+            for slot in self._slots:
+                if slot.request is not None:
+                    stranded.append(slot.request)
+                    slot.request = None
+        else:
+            get_logger(_LOG).warning(
+                "decode thread still running after stop timeout; leaving "
+                "in-slot requests to it (queued requests aborted)")
+        for req in stranded:
+            self._c_rejected.inc()
+            self._c_rej_abort.inc()
+            req.error = reason
+            req.done_t = time.perf_counter()
+            req._done.set()
+        if stranded:
+            get_logger(_LOG).warning(
+                "engine stop aborted %d outstanding request(s) "
+                "(recorded under serve.rejected)", len(stranded))
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- checkpoint promotion (the online-learning "deploy" seam) -----------
+    def promote(self, variables) -> None:
+        """Swap the serving weights — checkpoint promotion, the seam a
+        continual-training loop "deploys" through (ROADMAP: gate this on
+        drift-clean windows).  The decode thread adopts the new tree at
+        its next loop turn; shapes must match the current model, so no
+        program re-traces, and in-flight requests simply continue under
+        the promoted weights (online-learning semantics — a request is
+        not a consistency domain here)."""
+        import jax
+        new = jax.tree_util.tree_map(jax.numpy.asarray, variables)
+        with self._lock:
+            self._pending_variables = new
+            self._work.notify_all()
+        self._c_promotions.inc()
+
+    def _adopt_promotion(self) -> None:
+        with self._lock:
+            new = self._pending_variables
+            self._pending_variables = None
+        if new is not None:
+            self._variables = new
+
+    # -- admission ----------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None
+               ) -> ServeRequest:
+        """Queue one generation request.  Raises ``ValueError`` for
+        malformed requests (client error) and ``ServeRejected`` when the
+        admission controller load-sheds (queue full / draining)."""
+        self._c_requests.inc()
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.shape[0] < 1:
+            raise ValueError("prompt must hold at least one token")
+        max_new = self.config.max_new_tokens if max_new_tokens is None \
+            else int(max_new_tokens)
+        if not 1 <= max_new <= self.config.max_new_tokens:
+            raise ValueError(
+                f"max_new_tokens must lie in [1, "
+                f"{self.config.max_new_tokens}], got {max_new}")
+        # validates the prompt fits a bucket too
+        self.config.bucket_for(int(prompt.shape[0]), self._t)
+        if int(prompt.shape[0]) + max_new > self._t:
+            raise ValueError(
+                f"prompt length {prompt.shape[0]} + {max_new} new tokens "
+                f"exceeds the model's seq_len {self._t}")
+        req = ServeRequest(prompt, max_new)
+        with self._lock:
+            if self._draining:
+                self._c_rejected.inc()
+                self._c_rej_drain.inc()
+                raise ServeRejected("draining")
+            if len(self._queue) >= self.config.max_queue:
+                self._c_rejected.inc()
+                self._c_rej_full.inc()
+                raise ServeRejected("queue full")
+            self._queue.append(req)
+            self._g_queue.set(len(self._queue))
+            self._idle_evt.clear()
+            self._work.notify_all()
+        return req
+
+    # -- decode loop --------------------------------------------------------
+    def _free_slot(self) -> Optional[int]:
+        for i, slot in enumerate(self._slots):
+            if slot.request is None:
+                return i
+        return None
+
+    def _active_count(self) -> int:
+        return sum(1 for s in self._slots if s.request is not None)
+
+    def _admit(self) -> int:
+        """Move queued requests into free slots (prefill + scatter).
+        Decode-thread only; the queue pop is the one locked touch."""
+        admitted = 0
+        while True:
+            row = self._free_slot()
+            if row is None:
+                return admitted
+            with self._lock:
+                if not self._queue:
+                    return admitted
+                req = self._queue.popleft()
+                self._g_queue.set(len(self._queue))
+            req.admit_t = time.perf_counter()
+            self._h_queue_wait.observe(req.admit_t - req.submit_t)
+            bucket = self.config.bucket_for(req.length, self._t)
+            prompt = np.zeros((1, bucket), np.int32)
+            prompt[0, :req.length] = req.prompt
+            t0 = time.perf_counter()
+            self._sentinel(f"join.l{bucket}").observe(
+                (self._buf, self._cache, self._pos, self._logits, prompt,
+                 np.int32(req.length), np.int32(row)))
+            self._buf, self._cache, self._pos, self._logits = \
+                self._join_fn(bucket)(
+                    self._variables, self._buf, self._cache, self._pos,
+                    self._logits, prompt, np.int32(req.length),
+                    np.int32(row))
+            self._h_join.observe(time.perf_counter() - t0)
+            self._slots[row].request = req
+            self._c_admitted.inc()
+            self._c_joins.inc()
+            admitted += 1
+            self._g_active.set(self._active_count())
+
+    def _finish(self, row: int, now: float) -> None:
+        slot = self._slots[row]
+        req = slot.request
+        slot.request = None
+        req.done_t = now
+        self._c_completed.inc()
+        self._h_e2e.observe(now - req.submit_t)
+        req._done.set()
+
+    def _step_once(self) -> None:
+        active = np.array([s.request is not None for s in self._slots],
+                          bool)
+        t0 = time.perf_counter()
+        self._sentinel("step").observe(
+            (self._buf, self._cache, self._pos, self._logits, active,
+             self._rng))
+        self._buf, self._cache, self._pos, self._logits, self._rng, nxt = \
+            self._build_step()(self._variables, self._buf, self._cache,
+                               self._pos, self._logits, active, self._rng)
+        tokens = np.asarray(nxt)       # the per-step host readback
+        now = time.perf_counter()
+        dt = now - t0
+        self._h_step.observe(dt)
+        self._c_steps.inc()
+        eos = self.config.eos_id
+        for row, slot in enumerate(self._slots):
+            req = slot.request
+            if req is None:
+                continue
+            tok = int(tokens[row])
+            req.tokens.append(tok)
+            self._c_tokens.inc()
+            self._h_per_token.observe(dt)
+            if req.first_token_t is None:
+                req.first_token_t = now
+                self._h_ttft.observe(now - req.submit_t)
+            if len(req.tokens) >= req.max_new or \
+                    (eos is not None and tok == int(eos)):
+                self._finish(row, now)
+        self._g_active.set(self._active_count())
+
+    def _loop(self) -> None:
+        try:
+            while True:
+                # a hard stop (stop(drain=False)) exits immediately; the
+                # graceful path only sets the stop event once drained, so
+                # queued + in-flight work always finishes first.  The loop
+                # aborts its own slots on the way out — it is the slot
+                # owner, so this cannot race a step in progress
+                if self._stop_evt.is_set():
+                    self._abort_outstanding("aborted: engine stopped")
+                    return
+                self._adopt_promotion()
+                self._admit()
+                if self._active_count():
+                    # _idle_evt was cleared (under the lock) by the
+                    # submit() that queued this work
+                    self._step_once()
+                    continue
+                with self._lock:
+                    if self._queue:
+                        continue
+                    self._idle_evt.set()
+                    self._work.wait(_IDLE_WAIT_S)
+        except Exception:
+            # a dead decode thread must not strand waiters on requests
+            # that will never complete: fail them loudly as rejections
+            get_logger(_LOG).exception("decode loop crashed; aborting "
+                                       "outstanding requests")
+            with self._lock:
+                self._draining = True
+            self._idle_evt.set()
+            self._abort_outstanding("aborted: decode loop crashed")

@@ -14,6 +14,12 @@ Three modes:
   ``SocketParameterServer`` via its ``stats`` RPC and print the registry
   snapshot + straggler state (``--prometheus`` renders Prometheus text
   instead — pipe it anywhere that scrapes the standard format).
+* ``python scripts/obsview.py --serve HOST:PORT`` — poll a LIVE decode
+  service (``distkeras_tpu/serve``) via its ``stats`` RPC: the SLO
+  latency table (queue-wait / time-to-first-token / per-token /
+  end-to-end p50/p99), admission-control counters (requests, rejected by
+  reason), queue/slot occupancy, and the retrace sentinel — the serving
+  health check (ISSUE 7).
 * ``python scripts/obsview.py --diff BASE CAND`` — drift-gate two
   persisted registry-snapshot files (``obs.drift``): counter ratio deltas,
   bucket-wise PSI + p50/p99 shift per histogram, thresholds from the
@@ -439,6 +445,69 @@ def poll_stats(host: str, port: int) -> dict:
         return client.stats()
 
 
+#: the serving SLO surface, rendered in this order (ISSUE 7)
+_SLO_HISTS = (("serve.queue_wait_seconds", "queue wait"),
+              ("serve.ttft_seconds", "first token"),
+              ("serve.per_token_seconds", "per token"),
+              ("serve.e2e_seconds", "end-to-end"),
+              ("serve.step_seconds", "batch step"),
+              ("serve.join_seconds", "join (prefill)"))
+
+
+def summarize_serve(reply: dict) -> str:
+    """Live-poll summary from a decode service's ``stats`` RPC reply:
+    SLO latency table, admission counters, occupancy, retrace health."""
+    stats = reply.get("stats", {})
+
+    def _cval(name):
+        return stats.get(name, {}).get("value", 0)
+
+    lines = [f"== Live decode service ({reply.get('server', '?')}, "
+             f"model {reply.get('model', '?')}, "
+             f"{reply.get('slots', '?')} slots) ==",
+             f"buckets: {reply.get('prefill_buckets', '?')}   "
+             f"seq_len: {reply.get('seq_len', '?')}   "
+             f"queue: {reply.get('queue_depth', '?')}   active: "
+             f"{reply.get('active_slots', '?')}   draining: "
+             f"{reply.get('draining', '?')}",
+             "", "== SLO latency ==",
+             f"{'metric':<16} {'n':>8}  {'mean':>9}  {'p50':>9}  "
+             f"{'p99':>9}"]
+    for key, label in _SLO_HISTS:
+        h = stats.get(key)
+        if not h or not h.get("count"):
+            lines.append(f"{label:<16} {0:>8}")
+            continue
+        lines.append(
+            f"{label:<16} {h['count']:>8}  "
+            f"{_fmt_seconds(h['sum'] / h['count']):>9}  "
+            f"{_fmt_seconds(snapshot_quantile(h, 0.5)):>9}  "
+            f"{_fmt_seconds(snapshot_quantile(h, 0.99)):>9}")
+    lines += ["", "== Admission =="]
+    lines.append(f"requests: {_cval('serve.requests'):,.0f}   admitted: "
+                 f"{_cval('serve.admitted'):,.0f}   completed: "
+                 f"{_cval('serve.completed'):,.0f}   tokens_out: "
+                 f"{_cval('serve.tokens_out'):,.0f}")
+    lines.append(f"rejected: {_cval('serve.rejected'):,.0f}  "
+                 f"(queue_full {_cval('serve.rejected_queue_full'):,.0f}, "
+                 f"draining {_cval('serve.rejected_draining'):,.0f}, "
+                 f"aborted {_cval('serve.rejected_aborted'):,.0f})")
+    retraces = _cval("jit.retraces")
+    lines.append(f"jit: compiles {_cval('jit.compiles'):,.0f}  retraces "
+                 f"{retraces:,.0f}"
+                 + ("  << RETRACING (bucket instability)"
+                    if retraces else ""))
+    lines += ["", "== Instruments =="]
+    lines.extend(_instrument_lines(stats))
+    return "\n".join(lines)
+
+
+def poll_serve(host: str, port: int) -> dict:
+    from distkeras_tpu.serve import ServeClient
+    with ServeClient(host, int(port)) as client:
+        return client.stats()
+
+
 def run_diff(base: str, cand: str, thresholds=None) -> int:
     """``--diff`` body: drift-gate two snapshot files.  Exit codes are the
     CI contract — 0 clean, 1 drift, 2 unreadable/invalid input."""
@@ -484,6 +553,10 @@ def main(argv=None) -> int:
                     help="JSONL metrics file written by MetricsLogger")
     ap.add_argument("--ps", metavar="HOST:PORT",
                     help="poll a live SocketParameterServer's stats RPC")
+    ap.add_argument("--serve", metavar="HOST:PORT",
+                    help="poll a live decode service's stats RPC (SLO "
+                         "latency table, admission counters, retrace "
+                         "health)")
     ap.add_argument("--diff", nargs=2, metavar=("BASE", "CAND"),
                     help="compare two registry-snapshot files for "
                          "distribution drift (exit 0 clean / 1 drift / "
@@ -502,8 +575,8 @@ def main(argv=None) -> int:
                          "summary")
     args = ap.parse_args(argv)
 
-    if sum(map(bool, (args.jsonl, args.ps, args.diff))) != 1:
-        ap.error("need exactly one of JSONL, --ps or --diff")
+    if sum(map(bool, (args.jsonl, args.ps, args.serve, args.diff))) != 1:
+        ap.error("need exactly one of JSONL, --ps, --serve or --diff")
     if args.export_trace and not args.jsonl:
         ap.error("--export-trace needs a JSONL metrics file")
 
@@ -517,6 +590,15 @@ def main(argv=None) -> int:
         reply = poll_stats(host, int(port))
         emit(to_prometheus_text(reply.get("stats", {})) if args.prometheus
              else summarize_stats(reply))
+        return 0
+
+    if args.serve:
+        host, _, port = args.serve.rpartition(":")
+        if not host or not port.isdigit():
+            ap.error(f"--serve expects HOST:PORT, got {args.serve!r}")
+        reply = poll_serve(host, int(port))
+        emit(to_prometheus_text(reply.get("stats", {})) if args.prometheus
+             else summarize_serve(reply))
         return 0
 
     snap = load_snapshot(args.jsonl)

@@ -88,6 +88,51 @@ def test_jit_purity_sync_on_chained_and_subscript_receivers():
     assert all(".item()" in f.message for f in found)
 
 
+def test_jit_purity_follows_one_level_call_edge():
+    # ISSUE 7 (carried ROADMAP item): a function invoked BY NAME from a
+    # traced body runs at trace time too — its violations count
+    found = lint("""
+        import jax, time
+
+        def helper(x):
+            t = time.time()       # flagged: helper is called from step
+            return x + t
+
+        @jax.jit
+        def step(x):
+            return helper(x)
+        """, rule="jit-purity")
+    assert len(found) == 1
+    assert "time.time" in found[0].message
+
+
+def test_jit_purity_call_edge_stops_after_one_level():
+    # depth-2 callees and helpers only reachable from host code are NOT
+    # followed: one level trades recall for a bounded false-positive
+    # surface (same-name resolution is heuristic)
+    found = lint("""
+        import jax, time
+
+        def deep(x):
+            time.sleep(1)          # two edges away: not followed
+            return x
+
+        def mid(x):
+            return deep(x)
+
+        def host_only(x):
+            t = time.time()        # never traced: not flagged
+            return x + t
+
+        @jax.jit
+        def step(x):
+            return mid(x)
+
+        out = host_only(step(1))
+        """, rule="jit-purity")
+    assert found == []
+
+
 def test_jit_purity_negatives():
     # impure calls OUTSIDE traced functions are fine; jnp/lax inside are
     # fine; np.random.default_rng is the seeded object API, not flagged

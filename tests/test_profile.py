@@ -92,6 +92,48 @@ def test_retrace_sentinel_cold_warm_retrace(caplog):
     assert recs[0]["signature"]  # the shape/dtype tree hash rides along
 
 
+def test_retrace_sentinel_observe_key_and_warn_off(caplog):
+    # ISSUE 7: entry points with their own program cache (the decode
+    # runners) count by CACHE KEY — value-level program changes the
+    # shape signature cannot see (temperature, beam width) still count;
+    # warn=False keeps counters but silences the per-signature log
+    reg = Registry()
+    s = RetraceSentinel("decode", registry=reg, warn=False)
+    assert s.observe_key((16, 0.0)) == "cold"
+    assert s.observe_key((16, 0.0)) == "warm"
+    with caplog.at_level(logging.WARNING,
+                         logger="distkeras_tpu.obs.profile"):
+        assert s.observe_key((16, 0.8)) == "retrace"  # same shapes!
+    assert reg.counter("jit.compiles").value == 2
+    assert reg.counter("jit.retraces").value == 1
+    assert not [r for r in caplog.records if "retrace" in r.message]
+
+
+def test_generate_tokens_feeds_decode_sentinel():
+    from distkeras_tpu.models import generation, zoo
+    model = zoo.gpt_lm(vocab_size=16, dim=8, num_heads=2, num_blocks=1,
+                       seq_len=16)
+    v = model.init(0)
+    reg = Registry()
+    generation.set_decode_registry(reg)
+    try:
+        prompt = np.zeros((1, 4), np.int32)
+        generation.generate_tokens(model, v, prompt, 2)
+        c0 = reg.counter("jit.compiles").value
+        assert c0 >= 1
+        r0 = reg.counter("jit.retraces").value
+        # same config: steady state — no new compile, no retrace
+        generation.generate_tokens(model, v, prompt, 2)
+        assert reg.counter("jit.compiles").value == c0
+        assert reg.counter("jit.retraces").value == r0
+        # a VALUE-level program change (temperature) is a new program
+        # even though every arg shape is identical
+        generation.generate_tokens(model, v, prompt, 2, temperature=0.5)
+        assert reg.counter("jit.retraces").value == r0 + 1
+    finally:
+        generation.set_decode_registry(None)
+
+
 def test_sentinel_wrap_counts_without_changing_results():
     reg = Registry()
     s = RetraceSentinel("f", registry=reg)

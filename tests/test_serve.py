@@ -1,0 +1,495 @@
+"""Continuous-batching decode service (ISSUE 7): the engine's
+offline-decode parity, mid-decode joins, admission control (queue-full
+load shedding, draining rejections, hard-stop aborts — nothing drops
+without a recorded rejection), the serve wire (v1<->v2 interop over the
+shared hello seam), the steady-state ``jit.retraces == 0`` contract
+drift-gated by the committed ``OBS_BASELINE.json``, ``bench.py --serve``
+and the ``obsview --serve`` rendering."""
+
+import copy
+import importlib.util
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.models import zoo
+from distkeras_tpu.models.generation import generate_tokens
+from distkeras_tpu.obs import Registry, drift
+from distkeras_tpu.serve import (DecodeEngine, ServeClient, ServeConfig,
+                                 ServeRejected, ServeServer)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+VOCAB, SEQ = 32, 32
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = zoo.gpt_lm(vocab_size=VOCAB, dim=16, num_heads=2,
+                       num_blocks=1, seq_len=SEQ)
+    return model, model.init(0)
+
+
+def _engine(lm, registry=None, **kw):
+    model, v = lm
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_queue", 4)
+    kw.setdefault("max_new_tokens", 12)
+    return DecodeEngine(model, v, ServeConfig(**kw),
+                        registry=registry if registry is not None
+                        else Registry())
+
+
+def _ref(lm, prompt, steps, **kw):
+    """The offline decode's continuation for ``prompt`` — the ground
+    truth a continuously-batched request must reproduce."""
+    model, v = lm
+    out = generate_tokens(model, v,
+                          np.asarray(prompt, np.int32)[None, :],
+                          int(steps), **kw)
+    return np.asarray(out)[0, len(prompt):]
+
+
+def _prompt(rng, n):
+    return rng.integers(0, VOCAB, size=(n,)).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+def test_config_buckets_and_validation():
+    cfg = ServeConfig()
+    assert cfg.resolved_buckets(256) == (32, 64, 128, 256)
+    assert cfg.resolved_buckets(32) == (32,)
+    assert cfg.bucket_for(5, 256) == 32
+    assert cfg.bucket_for(65, 256) == 128
+    explicit = ServeConfig(prefill_buckets=(8, 16))
+    # the largest bucket is always topped up to seq_len
+    assert explicit.resolved_buckets(32) == (8, 16, 32)
+    with pytest.raises(ValueError):
+        ServeConfig(slots=0)
+    with pytest.raises(ValueError):
+        # admission flows through the queue: a zero-length queue would
+        # reject every request even with all slots idle
+        ServeConfig(max_queue=0)
+    with pytest.raises(ValueError):
+        ServeConfig(prefill_buckets=(64,)).resolved_buckets(32)
+    with pytest.raises(ValueError):
+        ServeConfig(temperature=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# engine: decode parity + continuous joins
+# ---------------------------------------------------------------------------
+
+def test_engine_matches_offline_decode(lm):
+    rng = np.random.default_rng(0)
+    with _engine(lm) as eng:
+        for n, steps in ((5, 8), (1, 4), (17, 12)):
+            prompt = _prompt(rng, n)
+            got = eng.submit(prompt, steps).result(timeout=60)
+            assert np.array_equal(got, _ref(lm, prompt, steps))
+
+
+def test_engine_eos_finishes_row_early(lm):
+    # pick a prompt whose greedy continuation's THIRD token is fresh, and
+    # use it as the "eos" so the engine must stop exactly there
+    prompt = full = eos = None
+    for seed in range(8):
+        rng = np.random.default_rng(seed)
+        prompt = _prompt(rng, 6)
+        full = _ref(lm, prompt, 8)
+        eos = int(full[2])
+        if eos not in (int(full[0]), int(full[1])):
+            break
+    else:
+        pytest.skip("every probed continuation repeats its 3rd token")
+    with _engine(lm, eos_id=eos) as eng:
+        got = eng.submit(prompt, 8).result(timeout=60)
+    assert list(got) == list(full[:3])  # stops AT the eos, inclusive
+
+
+def test_continuous_join_mid_decode(lm):
+    """The tentpole behavior: a request admitted while another is
+    mid-decode joins the running batch (no wait for the batch to end)
+    and completes — and the long request is unperturbed."""
+    rng = np.random.default_rng(2)
+    long_p, short_p = _prompt(rng, 4), _prompt(rng, 6)
+    reg = Registry()
+    with _engine(lm, registry=reg, max_new_tokens=24) as eng:
+        req_a = eng.submit(long_p, 24)
+        # wait until A is genuinely mid-decode (tokens flowing)
+        deadline = time.monotonic() + 30
+        while reg.counter("serve.tokens_out").value < 2:
+            assert time.monotonic() < deadline, "decode never started"
+            time.sleep(0.002)
+        req_b = eng.submit(short_p, 4)
+        got_b = req_b.result(timeout=60)
+        got_a = req_a.result(timeout=60)
+    assert not req_a.done or req_a.done_t >= req_b.admit_t  # B joined mid-A
+    assert req_b.done_t < req_a.done_t  # B retired while A kept going
+    assert np.array_equal(got_a, _ref(lm, long_p, 24))
+    assert np.array_equal(got_b, _ref(lm, short_p, 4))
+    assert reg.counter("serve.joins").value == 2
+    assert reg.counter("jit.retraces").value == 0
+
+
+def test_checkpoint_promotion_swaps_weights_without_retrace(lm):
+    """The online-learning "deploy" seam: promote() swaps the serving
+    weights between steps — subsequent requests decode under the new
+    checkpoint, and nothing re-traces (same shapes, same programs)."""
+    model, _ = lm
+    v_new = model.init(1)  # a different checkpoint of the same model
+    rng = np.random.default_rng(8)
+    prompt = _prompt(rng, 6)
+    reg = Registry()
+    with _engine(lm, registry=reg) as eng:
+        before = eng.submit(prompt, 8).result(timeout=60)
+        eng.promote(v_new)
+        after = eng.submit(prompt, 8).result(timeout=60)
+    assert np.array_equal(before, _ref(lm, prompt, 8))
+    ref_new = np.asarray(generate_tokens(
+        model, v_new, prompt[None, :], 8))[0, len(prompt):]
+    assert np.array_equal(after, ref_new)
+    assert not np.array_equal(before, after), \
+        "distinct checkpoints should decode differently"
+    assert reg.counter("serve.promotions").value == 1
+    assert reg.counter("jit.retraces").value == 0
+
+
+# ---------------------------------------------------------------------------
+# admission control + drain
+# ---------------------------------------------------------------------------
+
+def test_queue_full_load_shedding_counters(lm):
+    reg = Registry()
+    eng = _engine(lm, registry=reg, slots=1, max_queue=1)
+    # engine NOT started: the queue fills deterministically
+    first = eng.submit(np.arange(3), 4)
+    shed = 0
+    for _ in range(3):
+        with pytest.raises(ServeRejected) as ei:
+            eng.submit(np.arange(3), 4)
+        assert ei.value.reason == "queue full"
+        shed += 1
+    eng.start()
+    assert np.array_equal(first.result(timeout=60),
+                          _ref(lm, np.arange(3), 4))
+    eng.stop()
+    snap = reg.snapshot()
+    assert snap["serve.rejected"]["value"] == shed
+    assert snap["serve.rejected_queue_full"]["value"] == shed
+    assert snap["serve.admitted"]["value"] == 1
+    assert snap["serve.completed"]["value"] == 1
+    # nothing vanished: every submit is accounted completed or rejected
+    assert snap["serve.requests"]["value"] == \
+        snap["serve.completed"]["value"] + snap["serve.rejected"]["value"]
+
+
+def test_drain_completes_inflight_then_rejects(lm):
+    rng = np.random.default_rng(3)
+    prompt = _prompt(rng, 5)
+    reg = Registry()
+    eng = _engine(lm, registry=reg).start()
+    req = eng.submit(prompt, 10)
+    assert eng.drain(timeout=60)
+    assert req.done
+    assert np.array_equal(req.result(), _ref(lm, prompt, 10))
+    with pytest.raises(ServeRejected) as ei:
+        eng.submit(prompt, 4)
+    assert ei.value.reason == "draining"
+    eng.stop()
+    snap = reg.snapshot()
+    assert snap["serve.rejected_draining"]["value"] == 1
+    assert snap["serve.requests"]["value"] == \
+        snap["serve.completed"]["value"] + snap["serve.rejected"]["value"]
+
+
+def test_hard_stop_aborts_with_recorded_rejection(lm):
+    reg = Registry()
+    eng = _engine(lm, registry=reg)  # never started: request stays queued
+    req = eng.submit(np.arange(4), 8)
+    eng.stop(drain=False)
+    assert req.done and req.error is not None
+    with pytest.raises(ServeRejected):
+        req.result()
+    snap = reg.snapshot()
+    assert snap["serve.rejected_aborted"]["value"] == 1
+    assert snap["serve.requests"]["value"] == \
+        snap["serve.completed"]["value"] + snap["serve.rejected"]["value"]
+
+
+# ---------------------------------------------------------------------------
+# the serve wire
+# ---------------------------------------------------------------------------
+
+def test_server_v1_v2_interop(lm):
+    rng = np.random.default_rng(4)
+    p1, p2 = _prompt(rng, 5), _prompt(rng, 7)
+    with ServeServer(_engine(lm).warmup()) as srv:
+        with ServeClient("127.0.0.1", srv.port) as c2, \
+                ServeClient("127.0.0.1", srv.port, wire_version=1) as c1:
+            assert c2.wire_version == 2
+            assert c1.wire_version == 1
+            r2 = c2.generate(p1, 6)
+            r1 = c1.generate(p2, 6)
+            assert r2["ok"] and r1["ok"]
+            assert np.array_equal(np.asarray(r2["tokens"]),
+                                  _ref(lm, p1, 6))
+            assert np.array_equal(np.asarray(r1["tokens"]),
+                                  _ref(lm, p2, 6))
+            assert "ttft_s" in r2 and "queue_wait_s" in r2
+            st = c1.stats()
+            assert st["stats"]["serve.completed"]["value"] == 2
+    # a legacy v1-only SERVER: current clients fall back cleanly
+    with ServeServer(_engine(lm).warmup(), max_wire_version=1) as srv:
+        with ServeClient("127.0.0.1", srv.port) as c:
+            assert c.wire_version == 1
+            r = c.generate(p1, 4)
+            assert r["ok"]
+            assert np.array_equal(np.asarray(r["tokens"]),
+                                  _ref(lm, p1, 4))
+
+
+def test_server_burst_load_shedding(lm):
+    """Acceptance: an over-capacity burst sheds load — every reply is
+    either a completed generation or an explicit rejection, and the
+    server's counter agrees with what clients saw."""
+    rng = np.random.default_rng(5)
+    reg = Registry()
+    eng = _engine(lm, registry=reg, slots=1, max_queue=1,
+                  max_new_tokens=16)
+    prompts = [_prompt(rng, 4) for _ in range(6)]
+    replies = [None] * 6
+    with ServeServer(eng.warmup()) as srv:
+        clients = [ServeClient("127.0.0.1", srv.port) for _ in range(6)]
+
+        def go(k):
+            replies[k] = clients[k].generate(prompts[k], 16)
+
+        threads = [threading.Thread(target=go, args=(k,))
+                   for k in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for c in clients:
+            c.close()
+    ok = [k for k, r in enumerate(replies) if r["ok"]]
+    shed = [k for k, r in enumerate(replies)
+            if not r["ok"] and r.get("rejected")]
+    assert len(ok) + len(shed) == 6
+    assert shed, "burst over a 1-slot/1-queue service must shed load"
+    assert ok, "a shedding service must still complete admitted work"
+    for k in ok:  # completed requests are CORRECT under the burst
+        assert np.array_equal(np.asarray(replies[k]["tokens"]),
+                              _ref(lm, prompts[k], 16))
+    snap = reg.snapshot()
+    assert snap["serve.rejected"]["value"] == len(shed)
+    assert snap["serve.requests"]["value"] == \
+        snap["serve.completed"]["value"] + snap["serve.rejected"]["value"]
+
+
+def test_server_acceptance_continuous_join_steady_state(lm):
+    """Acceptance: a real multi-request run THROUGH the server — a
+    request admitted mid-decode of another joins the running batch and
+    completes correctly, and the whole run holds ``jit.retraces == 0``."""
+    rng = np.random.default_rng(9)
+    long_p, short_p = _prompt(rng, 4), _prompt(rng, 9)
+    reg = Registry()
+    eng = _engine(lm, registry=reg, max_new_tokens=24).warmup()
+    reply_a: dict = {}
+    with ServeServer(eng) as srv:
+        with ServeClient("127.0.0.1", srv.port) as ca, \
+                ServeClient("127.0.0.1", srv.port) as cb:
+            t = threading.Thread(
+                target=lambda: reply_a.update(ca.generate(long_p, 24)))
+            t.start()
+            deadline = time.monotonic() + 30
+            while reg.counter("serve.tokens_out").value < 2:
+                assert time.monotonic() < deadline, "decode never started"
+                time.sleep(0.002)
+            reply_b = cb.generate(short_p, 4)  # admitted mid-decode of A
+            t.join(timeout=30)
+            st = cb.stats()
+    assert reply_a.get("ok") and reply_b.get("ok")
+    assert np.array_equal(np.asarray(reply_a["tokens"]),
+                          _ref(lm, long_p, 24))
+    assert np.array_equal(np.asarray(reply_b["tokens"]),
+                          _ref(lm, short_p, 4))
+    assert st["stats"]["serve.joins"]["value"] == 2
+    assert st["stats"]["serve.completed"]["value"] == 2
+    assert st["stats"]["jit.retraces"]["value"] == 0
+
+
+def test_server_malformed_fields_answer_instead_of_dropping(lm):
+    """A malformed FIELD (not just an unknown action) must get an error
+    reply on the same connection, never a replyless disconnect."""
+    from distkeras_tpu.ps.networking import connect, recv_msg, send_msg
+    with ServeServer(_engine(lm).warmup()) as srv:
+        sock = connect("127.0.0.1", srv.port)
+        try:
+            send_msg(sock, {"action": "hello", "versions": ["two"]})
+            resp = recv_msg(sock)
+            assert resp["ok"] is False and "error" in resp
+            # the connection survived: a well-formed request still works
+            send_msg(sock, {"action": "generate",
+                            "prompt": np.arange(4, dtype=np.int32),
+                            "max_new_tokens": 2})
+            resp = recv_msg(sock)
+            assert resp["ok"] is True and len(resp["tokens"]) == 2
+        finally:
+            sock.close()
+
+
+def test_server_graceful_drain_over_wire(lm):
+    rng = np.random.default_rng(6)
+    prompt = _prompt(rng, 5)
+    reg = Registry()
+    srv = ServeServer(_engine(lm, registry=reg, max_new_tokens=24)
+                      .warmup()).start()
+    reply = {}
+    with ServeClient("127.0.0.1", srv.port) as c:
+        t = threading.Thread(
+            target=lambda: reply.update(c.generate(prompt, 24)))
+        t.start()
+        deadline = time.monotonic() + 30
+        while reg.counter("serve.tokens_out").value < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+        srv.stop()  # graceful: drains the in-flight generate first
+        t.join(timeout=30)
+    assert reply.get("ok"), reply
+    assert np.array_equal(np.asarray(reply["tokens"]),
+                          _ref(lm, prompt, 24))
+    snap = reg.snapshot()
+    assert snap["serve.requests"]["value"] == \
+        snap["serve.completed"]["value"] + snap["serve.rejected"]["value"]
+
+
+# ---------------------------------------------------------------------------
+# retrace contract (acceptance) + drift gate
+# ---------------------------------------------------------------------------
+
+def test_steady_state_retraces_zero_drift_gated(lm):
+    """Bucketed shapes mean the whole service compiles once per program
+    and NEVER re-traces under mixed traffic; the committed
+    OBS_BASELINE.json gates any increase as drift."""
+    rng = np.random.default_rng(7)
+    reg = Registry()
+    eng = _engine(lm, registry=reg, prefill_buckets=(8, SEQ),
+                  max_queue=8).warmup()
+    compiles_after_warmup = reg.counter("jit.compiles").value
+    assert compiles_after_warmup == 3  # 2 bucket joins + 1 step
+    with eng:
+        reqs = [eng.submit(_prompt(rng, n), 4)
+                for n in (3, 8, 12, 2, 20, 7)]  # spans both buckets
+        for r in reqs:
+            assert r.result(timeout=60).shape == (4,)
+    snap = reg.snapshot()
+    assert snap["jit.compiles"]["value"] == compiles_after_warmup
+    assert snap["jit.retraces"]["value"] == 0
+
+    # the drift gate: identical steady-state snapshots are clean, and a
+    # single retrace over the committed zero-tolerance rule is DRIFT
+    baseline = drift.load_baseline(os.path.join(_ROOT,
+                                                "OBS_BASELINE.json"))
+    doc = {"config": {"mode": "serve"}, "server": snap}
+    report = drift.diff_docs(doc, copy.deepcopy(doc), baseline=baseline)
+    assert not report.drifted
+    bumped = copy.deepcopy(doc)
+    bumped["server"]["jit.retraces"]["value"] += 1
+    report = drift.diff_docs(doc, bumped, baseline=baseline)
+    assert any(m.endswith("jit.retraces")
+               for m in report.drifted_metrics)
+
+
+# ---------------------------------------------------------------------------
+# bench.py --serve + obsview --serve
+# ---------------------------------------------------------------------------
+
+def test_bench_serve_emits_row_and_self_checks(tmp_path, monkeypatch):
+    if _ROOT not in sys.path:
+        sys.path.insert(0, _ROOT)
+    import bench
+    # point the designated baseline into the sandbox so the second run
+    # self-checks against the first (the committed BENCH_SERVE_OBS.json
+    # belongs to the full-size bench config)
+    monkeypatch.setattr(
+        bench, "_baseline_snapshot_path",
+        lambda cfg, key, default: str(tmp_path / default))
+    kw = dict(requests=6, concurrency=2, prompt_len=5, max_new=4,
+              slots=2, queue=4, out_dir=str(tmp_path), vocab=VOCAB,
+              dim=16, heads=2, blocks=1, seq_len=SEQ)
+    row = bench.bench_serve(**kw)
+    assert row["mode"] == "bench_serve"
+    assert row["rejected"] == 0  # closed loop under capacity never sheds
+    assert row["jit_retraces"] == 0
+    assert row["e2e_ms_p50"] > 0 and row["ttft_ms_p50"] > 0
+    assert row["tokens_per_sec"] > 0
+    assert row["obs_drift"] == {"checked": False,
+                                "reason": "no baseline snapshot"}
+    snap_path = tmp_path / "BENCH_SERVE_OBS.json"
+    assert snap_path.exists()
+    with open(snap_path) as f:
+        doc = json.load(f)
+    assert doc["config"]["requests"] == 6
+    # the zero-pinned sentinels are PRESENT (0), not missing
+    assert doc["server"]["jit.retraces"]["value"] == 0
+    assert doc["server"]["jit.compiles"]["value"] > 0
+    assert doc["server"]["serve.completed"]["value"] == 6
+    assert doc["client"]["serve.client.requests"]["value"] == 6
+
+    row2 = bench.bench_serve(**kw)
+    assert row2["obs_drift"]["checked"] is True
+
+
+def test_committed_serve_snapshot_matches_baseline_contract():
+    """The committed BENCH_SERVE_OBS.json is a valid registry-snapshot
+    document with the sentinels present at zero retraces — the state the
+    drift gate protects."""
+    path = os.path.join(_ROOT, "BENCH_SERVE_OBS.json")
+    assert os.path.exists(path), "bench.py --serve snapshot not committed"
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["config"]["mode"] == "bench_serve"
+    for part in ("client", "server"):
+        assert drift.is_registry_snapshot(doc[part])
+    assert doc["server"]["jit.retraces"]["value"] == 0
+    for name in ("serve.e2e_seconds", "serve.ttft_seconds",
+                 "serve.queue_wait_seconds", "serve.per_token_seconds"):
+        assert doc["server"][name]["count"] > 0
+    with open(os.path.join(_ROOT, "OBS_BASELINE.json")) as f:
+        bl = json.load(f)
+    assert bl["snapshots"]["serve_bench"] == "BENCH_SERVE_OBS.json"
+
+
+def _load_obsview():
+    spec = importlib.util.spec_from_file_location(
+        "obsview", os.path.join(_ROOT, "scripts", "obsview.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_obsview_serve_poll_renders_slo_table(lm):
+    obsview = _load_obsview()
+    with ServeServer(_engine(lm).warmup()) as srv:
+        eng = srv.engine
+        eng.submit(np.arange(4), 6).result(timeout=60)
+        out = obsview.summarize_serve(
+            obsview.poll_serve("127.0.0.1", srv.port))
+    assert "Live decode service" in out
+    assert "first token" in out and "end-to-end" in out
+    assert "retraces 0" in out
+    assert "RETRACING" not in out
+    # the alarm renders when the sentinel fired
+    reply = {"stats": {"jit.retraces": {"type": "counter", "value": 2},
+                       "jit.compiles": {"type": "counter", "value": 3}}}
+    assert "RETRACING" in obsview.summarize_serve(reply)
