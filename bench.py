@@ -415,6 +415,114 @@ def bench_serve(requests: int = 32, concurrency: int = 4,
     return row
 
 
+def bench_continual(intervals: int = 16, snapshot_every: int = 4,
+                    window: int = 4, batch: int = 16, history: int = 3,
+                    min_history: int = 2, drift_interval=10,
+                    out_dir: str = ROOT, vocab: int = 16, dim: int = 16,
+                    heads: int = 2, blocks: int = 1, seq_len: int = 16,
+                    lr: float = 1e-2, slots: int = 2,
+                    max_new: int = 8) -> dict:
+    """Continual-learning bench (ISSUE 8 acceptance): a bounded-duration
+    ``ContinualTrainer`` run over a simulated unbounded LM feed with a
+    LIVE ``DecodeEngine`` as the deploy target — training, windowed
+    drift gating, rolling checkpoints-in-registry, and gated promotes
+    all in one loop.  ``drift_interval`` injects an abrupt distribution
+    change into the feed at that interval boundary, so the committed run
+    records BOTH behaviors: drift-clean deploys before it and a
+    drift-dirty rejection (not a silent skip) at it.
+
+    One JSON row: deploy/rejection counts, verdict tally, stream-lag +
+    window-wall quantiles, the zero-pinned ``jit.retraces``.  The shared
+    trainer+engine registry snapshot AND the gate's window-verdict log
+    persist to ``BENCH_CONTINUAL_OBS.json``, drift-checked against the
+    committed baseline BEFORE overwriting it (the standard
+    ``OBS_BASELINE.json`` contract; config-incompatible runs divert to a
+    ``.variant.json`` sidecar)."""
+    from distkeras_tpu.continual import (ContinualConfig, ContinualTrainer,
+                                         synthetic_lm_feed)
+    from distkeras_tpu.models import zoo
+    from distkeras_tpu.obs import Registry, snapshot_quantile
+    from distkeras_tpu.serve import DecodeEngine, ServeConfig
+
+    intervals = int(intervals)
+    if intervals < 1:
+        raise ValueError(f"bench_continual needs intervals >= 1 "
+                         f"(got {intervals})")
+    model = zoo.gpt_lm(vocab_size=vocab, dim=dim, num_heads=heads,
+                       num_blocks=blocks, seq_len=seq_len)
+    reg = Registry()  # ONE registry: trainer + gate + engine + wire
+    engine = DecodeEngine(model, model.init(0),
+                          ServeConfig(slots=slots, max_new_tokens=max_new),
+                          registry=reg)
+    engine.warmup()
+    engine.start()
+    bl_cfg = _baseline_cfg()
+    cfg = ContinualConfig(batch_size=batch, window_steps=window,
+                          snapshot_every=snapshot_every, history=history,
+                          min_history=min_history)
+    # NOTE: the deploy gate runs on the built-in WITHIN-RUN thresholds
+    # (baseline=None).  OBS_BASELINE.json's continual.* entries tune the
+    # CROSS-run bench-vs-committed comparison below — its loosened
+    # continual.loss PSI would silently weaken the live gate
+    trainer = ContinualTrainer(model, "adam",
+                               "sparse_categorical_crossentropy",
+                               config=cfg, learning_rate=lr, registry=reg,
+                               deploy_to=engine)
+    drift_after = None if drift_interval is None else \
+        int(drift_interval) * snapshot_every * window
+    feed = synthetic_lm_feed(vocab, seq_len, batch, seed=0,
+                             drift_after=drift_after)
+    t0 = time.perf_counter()
+    try:
+        trainer.run(feed, intervals=intervals)
+    finally:
+        engine.stop()
+    wall = time.perf_counter() - t0
+
+    snap = reg.snapshot()
+
+    def _c(name):
+        return snap.get(name, {}).get("value", 0.0)
+
+    row = {
+        "metric": f"continual train+deploy loop (gpt_lm d{dim} "
+                  f"T{seq_len}, {intervals} intervals)",
+        "mode": "bench_continual",
+        "intervals": intervals,
+        "windows": _c("continual.windows"),
+        "samples_per_sec": round(_c("continual.samples") / wall, 1),
+        "deploys": _c("continual.deploys"),
+        "deploys_rejected": _c("continual.deploys_rejected"),
+        "rejected_dirty": _c("continual.rejected_dirty"),
+        "rejected_warmup": _c("continual.rejected_warmup"),
+        "verdicts": {k: _c(f"continual.verdicts_{k}")
+                     for k in ("stable", "step", "trend")},
+        "stream_lag_ms_p50": round(snapshot_quantile(
+            snap["continual.stream_lag_seconds"], 0.5) * 1e3, 3),
+        "window_ms_p50": round(snapshot_quantile(
+            snap["continual.window_seconds"], 0.5) * 1e3, 3),
+        "jit_retraces": snap["jit.retraces"]["value"],
+        "promotions": _c("serve.promotions"),
+    }
+    base_path = _baseline_snapshot_path(bl_cfg, "continual_bench",
+                                        "BENCH_CONTINUAL_OBS.json")
+    obs_doc = {"config": {"mode": "bench_continual",
+                          "intervals": intervals,
+                          "drift_interval": drift_interval,
+                          "lr": lr,
+                          "model": {"vocab": vocab, "dim": dim,
+                                    "heads": heads, "blocks": blocks,
+                                    "seq_len": seq_len},
+                          **cfg.config_row()},
+               "continual": snap,
+               "verdicts": trainer.gate.history_log()}
+    snap_path = os.path.join(out_dir, os.path.basename(base_path))
+    row["obs_drift"], snap_path = _persist_obs_snapshot(
+        snap_path, obs_doc, bl_cfg, base_path=base_path)
+    row["snapshot"] = os.path.relpath(snap_path, ROOT)
+    return row
+
+
 def bench_ps(codec: str = "none", windows: int = 50, mb: float = 4.0,
              out_dir: str = ROOT, wire_version=None,
              ps_workers: int = 1) -> dict:
@@ -553,6 +661,14 @@ def _cli(argv=None) -> int:
     ap.add_argument("--serve", action="store_true",
                     help="run the decode-service load bench instead of "
                          "the trainer headline")
+    ap.add_argument("--continual", action="store_true",
+                    help="run the continual-learning train+deploy loop "
+                         "bench instead of the trainer headline")
+    ap.add_argument("--intervals", type=int, default=16,
+                    help="bench_continual: obs intervals to run")
+    ap.add_argument("--drift-interval", type=int, default=10,
+                    help="bench_continual: interval at which the feed's "
+                         "distribution step-changes (-1 disables)")
     ap.add_argument("--requests", type=int, default=32,
                     help="bench_serve: total generation requests")
     ap.add_argument("--concurrency", type=int, default=4,
@@ -579,8 +695,16 @@ def _cli(argv=None) -> int:
                          "sweep points (e.g. 1,2,4); one JSON row and one "
                          "merged registry snapshot per point")
     args = ap.parse_args(argv)
-    if args.ps and args.serve:
-        ap.error("--ps and --serve are mutually exclusive")
+    if sum((args.ps, args.serve, args.continual)) > 1:
+        ap.error("--ps, --serve and --continual are mutually exclusive")
+    if args.continual:
+        if args.intervals < 1:
+            ap.error("--intervals must be >= 1")
+        print(json.dumps(bench_continual(
+            intervals=args.intervals,
+            drift_interval=None if args.drift_interval is not None
+            and args.drift_interval < 0 else args.drift_interval)))
+        return 0
     if args.serve:
         if args.requests < 1 or args.concurrency < 1:
             ap.error("--requests and --concurrency must be >= 1")

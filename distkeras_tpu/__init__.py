@@ -9,7 +9,7 @@ and a host-side asynchronous parameter server (async-parity path).
 
 __version__ = "0.1.0"
 
-from . import data, models, obs, ops, parallel, serve, utils
+from . import continual, data, models, obs, ops, parallel, serve, utils
 from .data import Dataset
 from .models import Model, Sequential, generate_beam, generate_tokens
 from .trainers import (
@@ -28,6 +28,7 @@ from .trainers import (
 )
 from .predictors import ModelPredictor, Predictor
 from .serve import DecodeEngine, ServeClient, ServeConfig, ServeServer
+from .continual import ContinualConfig, ContinualTrainer, DeployGate
 from .evaluators import AccuracyEvaluator, Evaluator, F1Evaluator, LossEvaluator
 from .job_deployment import Job, Punchcard
 from .models import zoo

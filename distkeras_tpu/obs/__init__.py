@@ -54,9 +54,13 @@ from .export import records_to_chrome_trace, write_chrome_trace  # noqa: F401
 from .drift import (  # noqa: F401
     BASELINE_SCHEMA,
     DEFAULT_THRESHOLDS,
+    WINDOW_KINDS,
     DriftReport,
+    WindowVerdict,
+    classify_window,
     diff_docs,
     diff_files,
     find_baseline,
     load_baseline,
+    snapshot_delta,
 )

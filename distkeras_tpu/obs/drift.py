@@ -31,6 +31,18 @@ The baseline file schema (``dktpu-obs-baseline/v1``)::
 ``bench.py`` diffs a fresh run against it before overwriting, and
 ``scripts/obsview.py --diff A B`` exposes the same comparison as a CLI
 (exit 0 clean / 1 drift / 2 usage error) for CI.
+
+ISSUE 8 adds the **windowed diff** over a rolling window of snapshots
+from ONE live run (the continual-training deploy gate): cumulative
+registry snapshots taken at interval edges are first differenced into
+per-interval deltas (:func:`snapshot_delta` — counters/histograms
+subtract so each interval describes what happened *during* it, not since
+process start), then :func:`classify_window` tells a **step change**
+(some consecutive interval pair drifts — an abrupt distribution jump)
+from a **gradual trend** (every consecutive pair is under threshold but
+the window's first→last cumulative diff drifts — slow creep no pairwise
+gate can see).  A window is *stable* only when neither fires; that is
+the drift-clean condition continual deploys gate on.
 """
 
 from __future__ import annotations
@@ -39,7 +51,7 @@ import fnmatch
 import json
 import math
 import os
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .registry import snapshot_quantile
 
@@ -307,6 +319,119 @@ def diff_docs(base_doc: dict, cand_doc: dict,
                 _compare_metric(metric, b[name], c[name],
                                 th.for_metric(metric)))
     return DriftReport(base_name, cand_name, findings, notes)
+
+
+# ---------------------------------------------------------------------------
+# windowed diff over one live run (ISSUE 8: the continual deploy gate)
+# ---------------------------------------------------------------------------
+
+#: the three windowed-diff outcomes, in increasing order of alarm
+WINDOW_KINDS = ("stable", "step", "trend")
+
+
+def _instrument_delta(base: dict, cand: dict) -> dict:
+    """One instrument's interval delta (see :func:`snapshot_delta`)."""
+    if base.get("type") != cand.get("type"):
+        return dict(cand)  # instrument re-registered as a new kind
+    if cand["type"] == "counter":
+        d = float(cand["value"]) - float(base["value"])
+        # a negative delta means the process restarted mid-window; the
+        # cand value IS that fresh process's interval
+        return {"type": "counter", "value": d if d >= 0 else cand["value"]}
+    if cand["type"] == "gauge":
+        return dict(cand)  # levels have no meaningful subtraction
+    if list(base["bounds"]) != list(cand["bounds"]):
+        return dict(cand)  # schema change: start the series over
+    counts = [c - b for b, c in zip(base["counts"], cand["counts"])]
+    if any(c < 0 for c in counts):
+        return dict(cand)  # restart mid-window
+    return {"type": "histogram", "bounds": list(cand["bounds"]),
+            "counts": counts, "sum": cand["sum"] - base["sum"],
+            "count": cand["count"] - base["count"]}
+
+
+def snapshot_delta(base: dict, cand: dict) -> dict:
+    """Interval delta between two cumulative ``Registry.snapshot()``s of
+    the SAME live registry taken at t0 < t1: counters and histograms
+    subtract (the delta describes what happened *during* [t0, t1]),
+    gauges keep the later level.  Metrics born mid-interval enter at
+    their cand value; metrics that vanished are dropped.  This is what
+    makes a long-running process's snapshots comparable as a series —
+    raw cumulative counters only ever grow, so consecutive raw snapshots
+    would always "drift"."""
+    out = {}
+    for name, c in cand.items():
+        b = base.get(name)
+        out[name] = _instrument_delta(b, c) if b is not None else dict(c)
+    return out
+
+
+class WindowVerdict(dict):
+    """One windowed-diff classification — a plain dict (JSON-friendly,
+    rides obs documents and the deploy log) with the sugar consumers
+    read: ``kind`` ∈ :data:`WINDOW_KINDS`, ``clean`` gates deploys."""
+
+    @property
+    def kind(self) -> str:
+        return self.get("kind", "stable")
+
+    @property
+    def clean(self) -> bool:
+        return self.kind == "stable"
+
+    @property
+    def dirty_metrics(self) -> List[str]:
+        return sorted(set(self.get("step_metrics", []))
+                      | set(self.get("trend_metrics", [])))
+
+
+def classify_window(intervals: Sequence[dict], baseline: Optional[dict] = None
+                    ) -> WindowVerdict:
+    """Classify a rolling window of per-interval snapshots (the outputs
+    of :func:`snapshot_delta`, oldest first) as ``stable`` / ``step`` /
+    ``trend``:
+
+    * **step** — some *consecutive* interval pair drifts under the
+      normal :func:`diff_docs` thresholds: an abrupt jump.  The verdict
+      stays dirty until the offending pair slides out of the window —
+      i.e. until every retained interval is post-jump and mutually
+      stable again.
+    * **trend** — no consecutive pair drifts, but the window's first →
+      last cumulative diff does: gradual creep, each step under
+      threshold, the sum over the window past it (the drift item's
+      long-open step-vs-trend distinction).
+    * **stable** — neither; the drift-clean condition deploys gate on.
+
+    Fewer than 2 intervals classify ``stable`` with ``intervals`` naming
+    how thin the evidence is — warm-up gating is the deploy gate's job
+    (``min_history``), not the classifier's."""
+    intervals = list(intervals)
+    n = len(intervals)
+    verdict = WindowVerdict(kind="stable", intervals=n,
+                            step_metrics=[], trend_metrics=[], details=[])
+    if n < 2:
+        verdict["details"] = ["fewer than 2 intervals: nothing to compare"]
+        return verdict
+    step: Dict[str, str] = {}
+    for i in range(n - 1):
+        rep = diff_docs(intervals[i], intervals[i + 1], baseline=baseline,
+                        base_name=f"interval[{i}]",
+                        cand_name=f"interval[{i + 1}]")
+        for f in rep.findings:
+            if f.drifted and f["metric"] not in step:
+                step[f["metric"]] = (f"step {i}->{i + 1}: "
+                                     f"{f.get('detail', '')}".rstrip())
+    cum = diff_docs(intervals[0], intervals[-1], baseline=baseline,
+                    base_name="interval[0]", cand_name=f"interval[{n - 1}]")
+    trend = {f["metric"]: f"trend 0->{n - 1}: {f.get('detail', '')}".rstrip()
+             for f in cum.findings
+             if f.drifted and f["metric"] not in step}
+    verdict["step_metrics"] = sorted(step)
+    verdict["trend_metrics"] = sorted(trend)
+    verdict["details"] = [step[m] for m in sorted(step)] + \
+                         [trend[m] for m in sorted(trend)]
+    verdict["kind"] = "step" if step else ("trend" if trend else "stable")
+    return verdict
 
 
 def diff_files(base_path: str, cand_path: str,

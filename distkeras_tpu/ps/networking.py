@@ -45,10 +45,12 @@ from __future__ import annotations
 import os
 import socket
 import struct
+import threading
 import time
 from typing import Any, List, Optional, Sequence, Tuple
 
 from ..obs import default_registry
+from ..obs.logging import get_logger
 from ..utils import serde
 
 _LEN = struct.Struct(">Q")
@@ -259,3 +261,198 @@ def recv_msg(sock: socket.socket, registry=None) -> Any:
     reg.counter("net.msgs_recv").inc()
     reg.counter("net.bytes_recv").inc(_LEN.size + n)
     return msg
+
+
+# ---------------------------------------------------------------------------
+# shared TCP front-end frame (ISSUE 8: ps.servers and serve.server carried
+# mirror copies of this accept/handler/stop machinery — one definition,
+# so a protocol or lifecycle fix lands once)
+# ---------------------------------------------------------------------------
+
+#: sentinel a ``handle_request`` implementation returns when it already
+#: sent its own reply on the connection (the PS pull path's
+#: pre-serialized ``send_packed`` payload)
+REPLY_SENT = object()
+
+
+class FrameServer:
+    """The TCP front-end both socket services share: listener + accept
+    loop, one daemon handler thread per connection (finished handlers
+    pruned per accept so a long-lived server polled once per obsview
+    tick never accumulates dead Thread objects), per-connection ``hello``
+    wire negotiation, a uniform error policy — a malformed FIELD answers
+    ``{"ok": False, "error": ...}`` on the same connection instead of
+    killing the handler replyless — and the stop sequencing: listener
+    first (no NEW connections), then the subclass's
+    ``_before_close_connections`` hook (the serve front-end drains its
+    engine here), then live sockets, then handler joins.
+
+    Subclasses implement ``handle_request(action, msg, ver, conn)``
+    returning a reply dict (sent on the negotiated wire version),
+    :data:`REPLY_SENT` when the reply already went out on ``conn``, or
+    ``None`` for an unknown action.  ``hello`` and ``stop`` are handled
+    here.  ``metric_prefix`` names the connections/in-flight gauges
+    (``<prefix>.connections`` / ``<prefix>.inflight``) and the log
+    channel (``<prefix>.server``); wire byte counts land in
+    ``registry`` so one ``stats`` snapshot covers protocol AND traffic.
+    """
+
+    #: obs/gauge/log prefix — "ps" and "serve" for the two front-ends
+    metric_prefix = "srv"
+
+    def __init__(self, registry, host: str = "127.0.0.1", port: int = 0,
+                 max_wire_version: int = WIRE_VERSION):
+        self.registry = registry
+        self.host = host
+        self.port = int(port)
+        #: newest frame format this server will negotiate; pin to 1 to
+        #: emulate (and interop-test against) a legacy v1-only server
+        self.max_wire_version = int(max_wire_version)
+        self._sock: Optional[socket.socket] = None
+        self._threads: list = []
+        self._conns: list = []
+        self._conn_lock = threading.Lock()
+        self._running = threading.Event()
+        self._g_conns = registry.gauge(f"{self.metric_prefix}.connections")
+        self._g_inflight = registry.gauge(f"{self.metric_prefix}.inflight")
+
+    # -- subclass hooks -----------------------------------------------------
+    def handle_request(self, action, msg: dict, ver: int,
+                       conn: socket.socket):
+        """One request -> a reply dict, :data:`REPLY_SENT`, or ``None``
+        (unknown action).  Runs on the connection's handler thread."""
+        raise NotImplementedError
+
+    def _on_start(self) -> None:
+        """After the listener is bound, before the accept thread spawns."""
+
+    def _before_close_connections(self) -> None:
+        """Between closing the listener and closing live connections —
+        where in-flight work drains so replies still flush."""
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "FrameServer":
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self.port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(128)
+        self._running.set()
+        self._on_start()
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name=f"{self.metric_prefix}-accept")
+        # _threads is appended by this (caller) thread AND the accept
+        # thread, and iterated by stop(): every touch goes through
+        # _conn_lock (dklint lock-discipline).  Append BEFORE start so
+        # index 0 is always the accept thread — an instant connection
+        # could otherwise slot a handler thread in first and stop()'s
+        # [1:] join would skip it.
+        with self._conn_lock:
+            self._threads.append(t)
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        self._running.clear()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._before_close_connections()
+        # close live connections so handlers blocked in recv unblock
+        with self._conn_lock:
+            conns = list(self._conns)
+            threads = list(self._threads)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in threads[1:]:
+            t.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- loops --------------------------------------------------------------
+    def _accept_loop(self):
+        while self._running.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # listener closed by stop()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conn_lock:
+                self._conns.append(conn)
+            self._g_conns.inc()
+            t = threading.Thread(target=self._handle_connection,
+                                 args=(conn,), daemon=True,
+                                 name=f"{self.metric_prefix}-conn")
+            t.start()
+            with self._conn_lock:
+                # prune finished handlers; index 0 stays the accept thread
+                self._threads[1:] = [h for h in self._threads[1:]
+                                     if h.is_alive()]
+                self._threads.append(t)
+
+    def _handle_connection(self, conn: socket.socket):
+        reg = self.registry
+        log = get_logger(f"{self.metric_prefix}.server")
+        ver = 1  # per-connection wire version; hello upgrades it
+        try:
+            while self._running.is_set():
+                try:
+                    msg = recv_msg(conn, registry=reg)
+                except (ConnectionError, OSError):
+                    return
+                action = msg.get("action")
+                self._g_inflight.inc()
+                try:
+                    if action == "hello":
+                        ver = choose_wire_version(msg.get("versions"),
+                                                  self.max_wire_version)
+                        # the reply itself stays v1-framed: the client
+                        # switches only after reading it
+                        send_msg(conn, {"ok": True, "version": ver},
+                                 registry=reg)
+                    elif action == "stop":
+                        send_msg(conn, {"ok": True}, registry=reg,
+                                 version=ver)
+                        return
+                    else:
+                        reply = self.handle_request(action, msg, ver, conn)
+                        if reply is None:
+                            reply = {"ok": False,
+                                     "error": f"unknown action {action!r}"}
+                        if reply is not REPLY_SENT:
+                            send_msg(conn, reply, registry=reg, version=ver)
+                except (ConnectionError, OSError) as e:
+                    log.warning("reply to %r failed (peer gone?): %s",
+                                action, e)
+                    return
+                except Exception as e:
+                    # a malformed FIELD (bad versions list, undecodable
+                    # codec stub, mismatched promote tree) answers like
+                    # any bad request instead of killing the handler and
+                    # dropping the peer's connection replyless
+                    log.warning("action %r failed: %s", action, e)
+                    try:
+                        send_msg(conn, {"ok": False, "error": str(e)},
+                                 registry=reg, version=ver)
+                    except (ConnectionError, OSError):
+                        return
+                finally:
+                    self._g_inflight.dec()
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._conn_lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+            self._g_conns.dec()

@@ -32,13 +32,12 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from ..obs import COUNT_BUCKETS, TIME_BUCKETS, Registry, StragglerDetector
-from ..obs.logging import get_logger
 from ..obs.spans import SpanTracer
 from ..parallel.sync import _inexact, tmap as _tree_map
 from ..utils import native
 from . import codecs
-from .networking import (WIRE_VERSION, choose_wire_version, pack_msg,
-                         recv_msg, send_msg, send_packed)
+from .networking import (REPLY_SENT, WIRE_VERSION, FrameServer, pack_msg,
+                         send_packed)
 
 Tree = Any
 
@@ -217,9 +216,11 @@ class DynSGDParameterServer(ParameterServer):
                                       1.0 / (staleness + 1))
 
 
-class SocketParameterServer:
+class SocketParameterServer(FrameServer):
     """TCP front-end: accept loop + one handler thread per worker connection
-    (parity: reference ``SocketParameterServer.run``/``handle_connection``).
+    (parity: reference ``SocketParameterServer.run``/``handle_connection``),
+    on the shared ``networking.FrameServer`` frame (ISSUE 8 — the accept/
+    handler/stop machinery previously mirrored by ``serve.server``).
 
     Protocol: each request is one framed msgpack map with an ``action`` key
     (``hello`` / ``pull`` / ``commit`` / ``stats`` / ``stop``); every
@@ -244,19 +245,20 @@ class SocketParameterServer:
     snapshot ride the ``stats`` reply.
     """
 
+    metric_prefix = "ps"
+
     def __init__(self, ps: ParameterServer, host: str = "127.0.0.1",
                  port: int = 0,
                  fault_injector: Optional[Callable[[str, dict], bool]] = None,
                  max_wire_version: int = WIRE_VERSION,
                  tracer: Optional[SpanTracer] = None,
                  straggler_detector: Optional[StragglerDetector] = None):
+        #: front-end instruments live in the PS's registry so one snapshot
+        #: covers update rules AND wire traffic
+        super().__init__(ps.registry, host=host, port=port,
+                         max_wire_version=max_wire_version)
         self.ps = ps
-        self.host = host
-        self.port = port
         self.fault_injector = fault_injector
-        #: newest frame format this server will negotiate; pin to 1 to
-        #: emulate (and interop-test against) a legacy v1-only server
-        self.max_wire_version = int(max_wire_version)
         #: server-side span tracer (ISSUE 5): when set, every commit apply
         #: runs inside a ``ps.apply`` span that ADOPTS the trace context a
         #: v2 client shipped in the request (``trace_id``/``parent_span``)
@@ -268,93 +270,15 @@ class SocketParameterServer:
         #: PS registry so the live ``stats`` RPC carries it
         self.stragglers = straggler_detector if straggler_detector \
             is not None else StragglerDetector(registry=ps.registry)
-        self._sock: Optional[socket.socket] = None
-        self._threads: list = []
-        self._conns: list = []
-        self._conn_lock = threading.Lock()
         #: pre-serialized pull replies: wire version -> (num_updates,
         #: pack_msg payload); every touch goes through _cache_lock
         self._pull_cache: dict = {}
         self._cache_lock = threading.Lock()
-        self._running = threading.Event()
-        #: front-end instruments live in the PS's registry so one snapshot
-        #: covers update rules AND wire traffic
-        self._g_conns = ps.registry.gauge("ps.connections")
-        self._g_inflight = ps.registry.gauge("ps.inflight")
         self._c_dropped = ps.registry.counter("ps.commits_dropped")
         self._c_unchanged = ps.registry.counter("ps.pulls_unchanged")
         self._c_cache_hits = ps.registry.counter("ps.pull_cache_hits")
         self._h_decode = ps.registry.histogram("ps.codec.decode_seconds",
                                                TIME_BUCKETS)
-
-    # -- lifecycle ----------------------------------------------------------
-    def start(self) -> "SocketParameterServer":
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((self.host, self.port))
-        self.port = self._sock.getsockname()[1]
-        self._sock.listen(128)
-        self._running.set()
-        t = threading.Thread(target=self._accept_loop, daemon=True,
-                             name="ps-accept")
-        # _threads is appended by this (caller) thread AND the accept
-        # thread, and iterated by stop(): every touch goes through
-        # _conn_lock (dklint lock-discipline).  Append BEFORE start so
-        # index 0 is always the accept thread — an instant connection
-        # could otherwise slot a handler thread in first and stop()'s
-        # [1:] join would skip it.
-        with self._conn_lock:
-            self._threads.append(t)
-        t.start()
-        return self
-
-    def stop(self) -> None:
-        self._running.clear()
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-        # close live worker connections so handlers blocked in recv unblock
-        with self._conn_lock:
-            conns = list(self._conns)
-            threads = list(self._threads)
-        for c in conns:
-            try:
-                c.close()
-            except OSError:
-                pass
-        for t in threads[1:]:
-            t.join(timeout=5)
-
-    def __enter__(self):
-        return self.start()
-
-    def __exit__(self, *exc):
-        self.stop()
-
-    # -- loops --------------------------------------------------------------
-    def _accept_loop(self):
-        while self._running.is_set():
-            try:
-                conn, _ = self._sock.accept()
-            except OSError:
-                return  # socket closed by stop()
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            with self._conn_lock:
-                self._conns.append(conn)
-            self._g_conns.inc()
-            t = threading.Thread(target=self._handle_connection, args=(conn,),
-                                 daemon=True, name="ps-conn")
-            t.start()
-            with self._conn_lock:
-                # prune finished handlers so a long-lived server (one
-                # short connection per obsview poll / worker retry) never
-                # accumulates dead Thread objects; index 0 stays the
-                # accept thread
-                self._threads[1:] = [h for h in self._threads[1:]
-                                     if h.is_alive()]
-                self._threads.append(t)
 
     def _center_payload(self, center, updates: int, ver: int):
         """Pre-serialized pull reply for this (counter, wire version) —
@@ -410,92 +334,37 @@ class SocketParameterServer:
         self._h_decode.observe(time.perf_counter() - t0)
         return delta
 
-    def _handle_connection(self, conn: socket.socket):
-        reg = self.ps.registry
-        ver = 1  # per-connection wire version; hello upgrades it
-        try:
-            while self._running.is_set():
-                try:
-                    msg = recv_msg(conn, registry=reg)
-                except (ConnectionError, OSError):
-                    return
-                action = msg.get("action")
-                self._g_inflight.inc()
-                try:
-                    if action == "hello":
-                        ver = choose_wire_version(msg.get("versions"),
-                                                  self.max_wire_version)
-                        # the reply itself stays v1-framed: the client
-                        # switches only after reading it
-                        send_msg(conn, {"ok": True, "version": ver},
-                                 registry=reg)
-                    elif action == "pull":
-                        with self._remote_span("ps.serve_pull", msg):
-                            have = msg.get("have")
-                            center, updates = self.ps.pull()
-                            if have is not None and int(have) == updates:
-                                self._c_unchanged.inc()
-                                send_msg(conn, {"unchanged": True,
-                                                "updates": updates},
-                                         registry=reg, version=ver)
-                            else:
-                                send_packed(conn,
-                                            self._center_payload(
-                                                center, updates, ver),
-                                            registry=reg)
-                    elif action == "commit":
-                        # liveness first: a dropped commit is still a
-                        # heartbeat — the fault injector models a lost
-                        # UPDATE, not a dead worker
-                        if msg.get("gap_s") is not None:
-                            self.stragglers.record(msg.get("worker_id"),
-                                                   msg.get("gap_s"))
-                        dropped = bool(
-                            self.fault_injector and
-                            self.fault_injector("commit", msg))
-                        if not dropped:
-                            delta = self._decoded_delta(msg)
-                            with self._remote_span("ps.apply", msg):
-                                self.ps.handle_commit(delta, msg)
-                        else:
-                            self._c_dropped.inc()
-                        send_msg(conn, {"ok": True, "dropped": dropped},
-                                 registry=reg, version=ver)
-                    elif action == "stats":
-                        reply = self.ps.stats()
-                        reply["stragglers"] = self.stragglers.snapshot()
-                        send_msg(conn, reply, registry=reg,
-                                 version=ver)
-                    elif action == "stop":
-                        send_msg(conn, {"ok": True}, registry=reg,
-                                 version=ver)
-                        return
-                    else:
-                        send_msg(conn, {"ok": False,
-                                        "error": f"unknown action {action!r}"},
-                                 registry=reg, version=ver)
-                except (ConnectionError, OSError):
-                    return  # peer gone mid-reply; nothing to answer
-                except Exception as e:
-                    # a malformed FIELD (bad versions list, undecodable
-                    # codec stub) answers like any bad request instead of
-                    # killing the handler and dropping the worker's
-                    # connection replyless
-                    get_logger("ps.server").warning("action %r failed: %s",
-                                                    action, e)
-                    try:
-                        send_msg(conn, {"ok": False, "error": str(e)},
-                                 registry=reg, version=ver)
-                    except (ConnectionError, OSError):
-                        return
-                finally:
-                    self._g_inflight.dec()
-        finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
-            with self._conn_lock:
-                if conn in self._conns:
-                    self._conns.remove(conn)
-            self._g_conns.dec()
+    def handle_request(self, action, msg: dict, ver: int,
+                       conn: socket.socket):
+        """PS protocol body on the shared frame (``hello``/``stop``/
+        errors live in ``FrameServer``)."""
+        if action == "pull":
+            with self._remote_span("ps.serve_pull", msg):
+                have = msg.get("have")
+                center, updates = self.ps.pull()
+                if have is not None and int(have) == updates:
+                    self._c_unchanged.inc()
+                    return {"unchanged": True, "updates": updates}
+                send_packed(conn, self._center_payload(center, updates, ver),
+                            registry=self.ps.registry)
+                return REPLY_SENT
+        if action == "commit":
+            # liveness first: a dropped commit is still a heartbeat — the
+            # fault injector models a lost UPDATE, not a dead worker
+            if msg.get("gap_s") is not None:
+                self.stragglers.record(msg.get("worker_id"),
+                                       msg.get("gap_s"))
+            dropped = bool(self.fault_injector and
+                           self.fault_injector("commit", msg))
+            if not dropped:
+                delta = self._decoded_delta(msg)
+                with self._remote_span("ps.apply", msg):
+                    self.ps.handle_commit(delta, msg)
+            else:
+                self._c_dropped.inc()
+            return {"ok": True, "dropped": dropped}
+        if action == "stats":
+            reply = self.ps.stats()
+            reply["stragglers"] = self.stragglers.snapshot()
+            return reply
+        return None

@@ -98,6 +98,17 @@ class ServeClient:
         slot state) — no decode work, safe under load."""
         return self._rpc({"action": "stats"}, retry=True)
 
+    def promote(self, variables) -> dict:
+        """Hot-swap the service's serving weights with ``variables`` —
+        the cross-process deploy seam (ISSUE 8): the continual trainer
+        promotes drift-clean checkpoints through this RPC, the tree
+        riding the v2 zero-copy tensor frame.  Returns the reply dict —
+        ``{"ok": True, "promotions": n}`` or ``{"ok": False, "error"}``
+        when the tree does not match the serving model.  No auto-retry:
+        like ``generate``, the server may have adopted the tree even
+        though the connection died, and a resend would double-promote."""
+        return self._rpc({"action": "promote", "variables": variables})
+
     def drain(self, timeout_s: Optional[float] = None) -> dict:
         """Ask the server to drain gracefully (idempotent)."""
         msg: dict = {"action": "drain"}

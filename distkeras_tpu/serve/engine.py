@@ -411,15 +411,40 @@ class DecodeEngine:
 
     # -- checkpoint promotion (the online-learning "deploy" seam) -----------
     def promote(self, variables) -> None:
-        """Swap the serving weights — checkpoint promotion, the seam a
-        continual-training loop "deploys" through (ROADMAP: gate this on
-        drift-clean windows).  The decode thread adopts the new tree at
-        its next loop turn; shapes must match the current model, so no
-        program re-traces, and in-flight requests simply continue under
-        the promoted weights (online-learning semantics — a request is
-        not a consistency domain here)."""
+        """Swap the serving weights — checkpoint promotion, the seam the
+        continual-training loop "deploys" through (ISSUE 8: gated on
+        drift-clean windows by ``continual.DeployGate``).  The decode
+        thread adopts the new tree at its next loop turn; shapes must
+        match the current model, so no program re-traces, and in-flight
+        requests simply continue under the promoted weights
+        (online-learning semantics — a request is not a consistency
+        domain here).
+
+        The tree is validated HERE, on the caller's thread: a promote
+        that would change the compiled programs' signatures (structure /
+        leaf shape / dtype — e.g. a wire-shipped tree for a different
+        model) raises ``ValueError`` to the caller (the ``promote`` RPC
+        answers an error) instead of crashing the decode loop, whose
+        death would strand every in-flight request."""
         import jax
         new = jax.tree_util.tree_map(jax.numpy.asarray, variables)
+        cur = self._variables
+        if jax.tree_util.tree_structure(new) != \
+                jax.tree_util.tree_structure(cur):
+            raise ValueError(
+                "promoted variables tree structure does not match the "
+                "serving model's")
+        bad = [f"{getattr(n, 'shape', ())}/{getattr(n, 'dtype', '?')} != "
+               f"{c.shape}/{c.dtype}"
+               for n, c in zip(jax.tree_util.tree_leaves(new),
+                               jax.tree_util.tree_leaves(cur))
+               if getattr(n, "shape", None) != c.shape
+               or getattr(n, "dtype", None) != c.dtype]
+        if bad:
+            raise ValueError(
+                f"promoted variables would re-trace the decode programs "
+                f"(leaf shape/dtype mismatch: {'; '.join(bad[:3])}"
+                f"{' ...' if len(bad) > 3 else ''})")
         with self._lock:
             self._pending_variables = new
             self._work.notify_all()

@@ -1,21 +1,28 @@
 """TCP front-end for the decode service (ISSUE 7).
 
 Speaks the PS wire (``ps.networking`` framing — v2 zero-copy tensor
-segments with per-connection v1/v2 hello negotiation, the exact seam the
-parameter-server stack uses), one handler thread per connection, every
-request one framed msgpack map with an ``action`` key:
+segments with per-connection v1/v2 hello negotiation) on the shared
+``networking.FrameServer`` frame (ISSUE 8: the accept loop, handler-
+thread bookkeeping and stop sequencing previously mirrored between this
+module and ``ps.servers`` live there once).  Every request is one framed
+msgpack map with an ``action`` key:
 
-* ``hello``    — wire-format negotiation (shared ``choose_wire_version``).
+* ``hello``    — wire-format negotiation (``FrameServer``).
 * ``generate`` — ``{"prompt": int32 array, "max_new_tokens": int?}`` ->
   ``{"ok": True, "tokens": int32 array, ...timings}`` or a load-shed
   ``{"ok": False, "rejected": True, "reason": ...}`` (admission control)
   or ``{"ok": False, "error": ...}`` for malformed requests.  Prompt and
   tokens ride as tensors — zero-copy on v2 connections.
 * ``stats``    — live registry snapshot + queue/slot state, no decode
-  work: the ``obsview --serve`` poll path.
+  work: the ``obsview --serve`` / ``--continual`` poll path.
+* ``promote``  — ``{"variables": pytree}`` -> checkpoint hot-swap via
+  ``engine.promote()`` (ISSUE 8: the cross-process deploy seam the
+  continual trainer uses; the tree rides the v2 zero-copy frame).  A
+  tree that does not match the serving model's answers ``{"ok": False,
+  "error": ...}`` — the decode loop never sees it.
 * ``drain``    — start a graceful drain (admission closes, in-flight
   completes); idempotent.
-* ``stop``     — close THIS connection (parity with the PS protocol).
+* ``stop``     — close THIS connection (``FrameServer``).
 
 ``stop(drain=True)`` (default, also the context-manager exit) closes the
 listener, drains the engine — every in-flight request completes, every
@@ -26,119 +33,57 @@ closes live connections.
 from __future__ import annotations
 
 import socket
-import threading
 import time
 from typing import Optional
 
 import numpy as np
 
-from ..obs.logging import get_logger
-from ..ps.networking import (WIRE_VERSION, choose_wire_version, recv_msg,
-                             send_msg)
+from ..ps.networking import WIRE_VERSION, FrameServer
 from .engine import DecodeEngine, ServeRejected
 
-_LOG = "serve.server"
 
-
-class ServeServer:
-    """Accept loop + per-connection handlers over a ``DecodeEngine``.
+class ServeServer(FrameServer):
+    """Accept loop + per-connection handlers over a ``DecodeEngine``,
+    on the shared TCP front-end frame.
 
     The engine's registry is the server's too (``serve.connections`` and
     the wire byte counts land beside the SLO histograms), so one
     ``stats`` reply describes the whole service."""
 
+    metric_prefix = "serve"
+
     def __init__(self, engine: DecodeEngine, host: str = "127.0.0.1",
                  port: int = 0, max_wire_version: int = WIRE_VERSION):
+        super().__init__(engine.registry, host=host, port=port,
+                         max_wire_version=max_wire_version)
         self.engine = engine
-        self.host = host
-        self.port = port
-        #: pin to 1 to emulate (and interop-test against) a legacy server
-        self.max_wire_version = int(max_wire_version)
-        self.registry = engine.registry
-        self._sock: Optional[socket.socket] = None
-        self._threads: list = []
-        self._conns: list = []
-        self._conn_lock = threading.Lock()
-        self._running = threading.Event()
-        self._g_conns = self.registry.gauge("serve.connections")
-        self._g_inflight = self.registry.gauge("serve.inflight")
+        # stop() parameters stashed for the frame's drain hook
+        self._stop_drain = True
+        self._stop_timeout: Optional[float] = None
 
-    # -- lifecycle ----------------------------------------------------------
-    def start(self) -> "ServeServer":
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((self.host, self.port))
-        self.port = self._sock.getsockname()[1]
-        self._sock.listen(128)
-        self._running.set()
+    # -- lifecycle hooks ----------------------------------------------------
+    def _on_start(self) -> None:
         if self.engine._thread is None:
             self.engine.start()
-        t = threading.Thread(target=self._accept_loop, daemon=True,
-                             name="serve-accept")
-        # same _threads contract as the PS front-end: index 0 is always
-        # the accept thread; every touch goes through _conn_lock
-        with self._conn_lock:
-            self._threads.append(t)
-        t.start()
-        return self
 
     def stop(self, drain: bool = True,
              timeout: Optional[float] = None) -> None:
         """Shut down: close the listener first (no NEW connections), then
         drain the engine (in-flight generates complete and their replies
         go out), then unblock idle handlers by closing live sockets."""
-        self._running.clear()
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-        self.engine.stop(drain=drain, timeout=timeout)
+        self._stop_drain = bool(drain)
+        self._stop_timeout = timeout
+        super().stop()
+
+    def _before_close_connections(self) -> None:
+        self.engine.stop(drain=self._stop_drain, timeout=self._stop_timeout)
         # let handlers flush replies for requests the drain just
         # completed before their sockets are pulled out from under them
         deadline = time.monotonic() + 5.0
         while self._g_inflight.value > 0 and time.monotonic() < deadline:
             time.sleep(0.01)
-        with self._conn_lock:
-            conns = list(self._conns)
-            threads = list(self._threads)
-        for c in conns:
-            try:
-                c.close()
-            except OSError:
-                pass
-        for t in threads[1:]:
-            t.join(timeout=5)
 
-    def __enter__(self):
-        return self.start()
-
-    def __exit__(self, *exc):
-        self.stop()
-
-    # -- loops --------------------------------------------------------------
-    def _accept_loop(self):
-        while self._running.is_set():
-            try:
-                conn, _ = self._sock.accept()
-            except OSError:
-                return  # listener closed by stop()
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            with self._conn_lock:
-                self._conns.append(conn)
-            self._g_conns.inc()
-            t = threading.Thread(target=self._handle_connection,
-                                 args=(conn,), daemon=True,
-                                 name="serve-conn")
-            t.start()
-            with self._conn_lock:
-                # prune finished handlers so a long-lived server (one
-                # short connection per obsview poll) never accumulates
-                # dead Thread objects; index 0 stays the accept thread
-                self._threads[1:] = [h for h in self._threads[1:]
-                                     if h.is_alive()]
-                self._threads.append(t)
-
+    # -- request handlers ---------------------------------------------------
     def _stats_reply(self) -> dict:
         eng = self.engine
         with eng._lock:
@@ -179,68 +124,33 @@ class ServeServer:
             reply["ttft_s"] = req.first_token_t - req.submit_t
         return reply
 
-    def _handle_connection(self, conn: socket.socket):
-        reg = self.registry
-        ver = 1  # per-connection wire version; hello upgrades it
+    def _handle_promote(self, msg: dict) -> dict:
+        """Checkpoint hot-swap over the wire — the deploy seam a
+        cross-process continual trainer promotes through (ISSUE 8)."""
+        variables = msg.get("variables")
+        if variables is None:
+            return {"ok": False, "error": "promote needs a variables tree"}
         try:
-            while self._running.is_set():
-                try:
-                    msg = recv_msg(conn, registry=reg)
-                except (ConnectionError, OSError):
-                    return
-                action = msg.get("action")
-                self._g_inflight.inc()
-                try:
-                    if action == "hello":
-                        ver = choose_wire_version(msg.get("versions"),
-                                                  self.max_wire_version)
-                        # reply stays v1-framed: the client switches only
-                        # after reading it
-                        send_msg(conn, {"ok": True, "version": ver},
-                                 registry=reg)
-                    elif action == "generate":
-                        send_msg(conn, self._handle_generate(msg),
-                                 registry=reg, version=ver)
-                    elif action == "stats":
-                        send_msg(conn, self._stats_reply(), registry=reg,
-                                 version=ver)
-                    elif action == "drain":
-                        drained = self.engine.drain(
-                            timeout=msg.get("timeout_s"))
-                        send_msg(conn, {"ok": True, "drained": drained},
-                                 registry=reg, version=ver)
-                    elif action == "stop":
-                        send_msg(conn, {"ok": True}, registry=reg,
-                                 version=ver)
-                        return
-                    else:
-                        send_msg(conn,
-                                 {"ok": False,
-                                  "error": f"unknown action {action!r}"},
-                                 registry=reg, version=ver)
-                except (ConnectionError, OSError) as e:
-                    get_logger(_LOG).warning(
-                        "reply to %r failed (peer gone?): %s", action, e)
-                    return
-                except Exception as e:
-                    # a malformed FIELD (e.g. a non-numeric version list)
-                    # must answer like any bad request, not kill the
-                    # handler and drop the connection replyless
-                    get_logger(_LOG).warning("action %r failed: %s",
-                                             action, e)
-                    try:
-                        send_msg(conn, {"ok": False, "error": str(e)},
-                                 registry=reg, version=ver)
-                    except (ConnectionError, OSError):
-                        return
-                finally:
-                    self._g_inflight.dec()
-        finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
-            with self._conn_lock:
-                if conn in self._conns:
-                    self._conns.remove(conn)
-            self._g_conns.dec()
+            self.engine.promote(variables)
+        except (ValueError, TypeError) as e:
+            # a mismatched tree is a BAD REQUEST: answer it, don't hand
+            # the decode thread state it would crash on
+            return {"ok": False, "error": str(e)}
+        return {"ok": True,
+                "promotions":
+                    int(self.engine._c_promotions.value)}
+
+    def handle_request(self, action, msg: dict, ver: int,
+                       conn: socket.socket):
+        """Serve protocol body on the shared frame (``hello``/``stop``/
+        errors live in ``FrameServer``)."""
+        if action == "generate":
+            return self._handle_generate(msg)
+        if action == "stats":
+            return self._stats_reply()
+        if action == "promote":
+            return self._handle_promote(msg)
+        if action == "drain":
+            drained = self.engine.drain(timeout=msg.get("timeout_s"))
+            return {"ok": True, "drained": drained}
+        return None

@@ -508,6 +508,113 @@ def poll_serve(host: str, port: int) -> dict:
         return client.stats()
 
 
+#: the continual-training health surface, rendered in this order (ISSUE 8)
+_CONTINUAL_HISTS = (("continual.loss", "loss"),
+                    ("continual.window_seconds", "window wall"),
+                    ("continual.stream_lag_seconds", "stream lag"))
+
+
+def summarize_continual(stats: dict, verdicts=None,
+                        source: str = "live") -> str:
+    """Continual-loop summary (ISSUE 8): deploy history, window-verdict
+    tally (with the per-interval table when the decision log is
+    available — the persisted ``BENCH_CONTINUAL_OBS.json`` carries it),
+    training-health histograms, and the two alarms: DRIFT-DIRTY (the
+    current window classifies step/trend — deploys blocked) and
+    RETRACING (the serve health check's sentinel rule)."""
+
+    def _cval(name):
+        return stats.get(name, {}).get("value", 0)
+
+    lines = [f"== Continual training ({source}) ==",
+             f"intervals: {_cval('continual.intervals'):,.0f}   windows: "
+             f"{_cval('continual.windows'):,.0f}   samples: "
+             f"{_cval('continual.samples'):,.0f}   checkpoints: "
+             f"{_cval('continual.checkpoints'):,.0f}"]
+    dirty_now = _cval("continual.window_dirty") > 0
+    lines.append(
+        f"deploys: {_cval('continual.deploys'):,.0f}   rejected: "
+        f"{_cval('continual.deploys_rejected'):,.0f}  (dirty "
+        f"{_cval('continual.rejected_dirty'):,.0f}, warmup "
+        f"{_cval('continual.rejected_warmup'):,.0f})   errors: "
+        f"{_cval('continual.deploy_errors'):,.0f}"
+        + ("  << DRIFT-DIRTY (deploys blocked)" if dirty_now else ""))
+    lines.append(f"verdicts: stable {_cval('continual.verdicts_stable'):,.0f}"
+                 f"  step {_cval('continual.verdicts_step'):,.0f}"
+                 f"  trend {_cval('continual.verdicts_trend'):,.0f}")
+    retraces = _cval("jit.retraces")
+    lines.append(f"jit: compiles {_cval('jit.compiles'):,.0f}  retraces "
+                 f"{retraces:,.0f}"
+                 + ("  << RETRACING (shape instability)" if retraces
+                    else ""))
+    lines += ["", "== Training health ==",
+              f"{'metric':<14} {'n':>8}  {'mean':>9}  {'p50':>9}  "
+              f"{'p99':>9}"]
+    for key, label in _CONTINUAL_HISTS:
+        h = stats.get(key)
+        if not h or not h.get("count"):
+            lines.append(f"{label:<14} {0:>8}")
+            continue
+        if key == "continual.loss":  # loss is unitless, not seconds
+            lines.append(f"{label:<14} {h['count']:>8}  "
+                         f"{h['sum'] / h['count']:>9.4f}  "
+                         f"{snapshot_quantile(h, 0.5):>9.4f}  "
+                         f"{snapshot_quantile(h, 0.99):>9.4f}")
+        else:
+            lines.append(
+                f"{label:<14} {h['count']:>8}  "
+                f"{_fmt_seconds(h['sum'] / h['count']):>9}  "
+                f"{_fmt_seconds(snapshot_quantile(h, 0.5)):>9}  "
+                f"{_fmt_seconds(snapshot_quantile(h, 0.99)):>9}")
+    if verdicts:
+        lines += ["", "== Window verdicts ==",
+                  f"{'interval':>8}  {'kind':<7} {'deployed':<9} reason"]
+        for e in verdicts:
+            mark = "DEPLOYED" if e.get("deployed") else \
+                ("accepted" if e.get("deploy") else "-")
+            lines.append(f"{e.get('interval', '?'):>8}  "
+                         f"{e.get('kind', '?'):<7} {mark:<9} "
+                         f"{e.get('reason', '')}")
+    serving = [k for k in stats if k.startswith("serve.")]
+    if serving:
+        lines += ["", "== Serving (same process) =="]
+        lines.append(f"promotions: {_cval('serve.promotions'):,.0f}   "
+                     f"completed: {_cval('serve.completed'):,.0f}   "
+                     f"rejected: {_cval('serve.rejected'):,.0f}")
+    return "\n".join(lines)
+
+
+def run_continual(target: str) -> int:
+    """``--continual`` body: live HOST:PORT (the decode service's
+    ``stats`` RPC — a trainer sharing the engine's registry shows up in
+    the same snapshot) or a persisted ``BENCH_CONTINUAL_OBS.json``."""
+    host, _, port = target.rpartition(":")
+    if host and port.isdigit():
+        reply = poll_serve(host, int(port))
+        emit(summarize_continual(reply.get("stats", {}),
+                                 source=f"live {target}"))
+        return 0
+    try:
+        doc = load_snapshot(target)
+    except OSError as e:
+        emit(f"obsview --continual: cannot read {target}: {e}", err=True)
+        return 2
+    if doc is None:
+        emit(f"obsview --continual: {target} is neither HOST:PORT nor a "
+             "registry-snapshot file", err=True)
+        return 2
+    regs = list(drift.named_registries(doc).values())
+    if not regs:
+        emit(f"obsview --continual: no registry snapshot in {target}",
+             err=True)
+        return 2
+    from distkeras_tpu.obs import Registry
+    stats = regs[0] if len(regs) == 1 else Registry.merge_snapshots(*regs)
+    emit(summarize_continual(stats, verdicts=doc.get("verdicts"),
+                             source=os.path.basename(target)))
+    return 0
+
+
 def run_diff(base: str, cand: str, thresholds=None) -> int:
     """``--diff`` body: drift-gate two snapshot files.  Exit codes are the
     CI contract — 0 clean, 1 drift, 2 unreadable/invalid input."""
@@ -557,6 +664,13 @@ def main(argv=None) -> int:
                     help="poll a live decode service's stats RPC (SLO "
                          "latency table, admission counters, retrace "
                          "health)")
+    ap.add_argument("--continual", metavar="TARGET",
+                    help="continual-loop view (ISSUE 8): HOST:PORT polls "
+                         "a live decode service whose registry the "
+                         "continual trainer shares; a file path reads a "
+                         "persisted BENCH_CONTINUAL_OBS.json (window "
+                         "verdicts, deploy history, stream lag, "
+                         "DRIFT-DIRTY/RETRACING alarms)")
     ap.add_argument("--diff", nargs=2, metavar=("BASE", "CAND"),
                     help="compare two registry-snapshot files for "
                          "distribution drift (exit 0 clean / 1 drift / "
@@ -575,13 +689,18 @@ def main(argv=None) -> int:
                          "summary")
     args = ap.parse_args(argv)
 
-    if sum(map(bool, (args.jsonl, args.ps, args.serve, args.diff))) != 1:
-        ap.error("need exactly one of JSONL, --ps, --serve or --diff")
+    if sum(map(bool, (args.jsonl, args.ps, args.serve, args.continual,
+                      args.diff))) != 1:
+        ap.error("need exactly one of JSONL, --ps, --serve, --continual "
+                 "or --diff")
     if args.export_trace and not args.jsonl:
         ap.error("--export-trace needs a JSONL metrics file")
 
     if args.diff:
         return run_diff(args.diff[0], args.diff[1], args.thresholds)
+
+    if args.continual:
+        return run_continual(args.continual)
 
     if args.ps:
         host, _, port = args.ps.rpartition(":")

@@ -141,10 +141,20 @@ def test_run_repeat_reports_median_and_spread(tmp_path):
     assert lo <= row["samples_per_sec"] <= hi
     assert row["note"] == "median of 2 warm runs"
     assert row["samples_per_sec"] > 0
-    # the single-epoch ('incl. compile') branch must measure EACH call's
-    # samples, not the accumulated history (review r5: cumulative
-    # samples over per-call wall made warm repeat k read ~k× the truth,
-    # i.e. rates grew monotonically with the repeat index)
+
+
+@pytest.mark.slow
+def test_run_repeat_warm_rates_measure_each_call():
+    """The single-epoch ('incl. compile') branch must measure EACH call's
+    samples, not the accumulated history (review r5: cumulative samples
+    over per-call wall made warm repeat k read ~k× the truth, i.e. rates
+    grew monotonically with the repeat index).
+
+    Marked slow (ISSUE 8 satellite): the warm-rate RATIO is a pure
+    wall-clock assertion — it passes in isolation but flakes under
+    full-suite host contention (the PR 7 tier-1 diff's one noise entry),
+    so it runs outside the tier-1 gate.  The deterministic spread
+    contract stays tier-1 above."""
     cfg1 = cfg_mod.RunConfig(
         name="rep1", trainer="SingleTrainer", model="mlp_mnist",
         model_kwargs={"hidden": 32}, dataset="load_mnist",
