@@ -175,9 +175,15 @@ def wrap_ps(ps) -> None:
     if not isinstance(ps.mutex, TrackedLock):
         ps.mutex = TrackedLock(ps.mutex)
     name = type(ps).__name__
-    if not isinstance(ps.commits_by_worker, GuardedDict):
-        ps.commits_by_worker = GuardedDict(
-            ps.mutex, f"{name}.commits_by_worker", ps.commits_by_worker)
+    # every mutex-guarded shared dict, the ISSUE 9 fleet-lifecycle state
+    # (generations/tombstones/eviction tallies) included — commit handler
+    # threads and the supervisor thread both touch them
+    for attr in ("commits_by_worker", "generations", "tombstoned_by_worker",
+                 "evictions_by_worker", "respawns_by_worker",
+                 "joins_by_worker"):
+        cur = getattr(ps, attr, None)
+        if cur is not None and not isinstance(cur, GuardedDict):
+            setattr(ps, attr, GuardedDict(ps.mutex, f"{name}.{attr}", cur))
     by_worker = getattr(ps, "_h_by_worker", None)
     if by_worker is not None and not isinstance(by_worker, GuardedDict):
         ps._h_by_worker = GuardedDict(ps.mutex, f"{name}._h_by_worker",

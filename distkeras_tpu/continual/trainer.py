@@ -149,6 +149,7 @@ class ContinualTrainer:
         self._c_intervals = reg.counter("continual.intervals")
         self._c_checkpoints = reg.counter("continual.checkpoints")
         self._c_deploy_errors = reg.counter("continual.deploy_errors")
+        self._c_restarts = reg.counter("continual.restarts")
         self._h_loss = reg.histogram("continual.loss",
                                      self.config.loss_buckets)
         self._h_window = reg.histogram("continual.window_seconds",
@@ -163,12 +164,23 @@ class ContinualTrainer:
 
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: daemon self-healing (ISSUE 9): ``start(max_restarts=N,
+        #: feed_factory=...)`` lets a crashed loop restart from its
+        #: latest checkpoint, the feed fast-forwarded to the exact
+        #: recorded offset
+        self._max_restarts = 0
+        self._feed_factory = None
         #: latest trained variables (host copy, set at interval edges and
         #: on run exit) and the latest tree actually deployed
         self.variables = None
         self.deployed = None
         self.deployed_interval: Optional[int] = None
         self.intervals_done = 0
+        #: exact stream position: batches consumed up to the latest
+        #: CHECKPOINTED interval edge (restored on resume) — the offset a
+        #: replayable feed fast-forwards to after a crash
+        self.batches_consumed = 0
+        self._end_interval: Optional[int] = None
 
     # -- deploy seam --------------------------------------------------------
     def _promote(self, host_vars) -> None:
@@ -215,6 +227,7 @@ class ContinualTrainer:
             (variables, opt_state, rng), meta = ckpt.restore(
                 (variables, opt_state, rng))
             interval = int(meta.get("interval", -1)) + 1
+            self.batches_consumed = int(meta.get("batches_consumed", 0))
             # exact stream resume: one interval is a FIXED batch count,
             # so meta["batches_consumed"] is the offset a replayable feed
             # fast-forwards to before calling run() again
@@ -222,6 +235,9 @@ class ContinualTrainer:
                 "resumed from interval %d (%s batches consumed)",
                 interval - 1, meta.get("batches_consumed", "?"))
         end = None if bound is None else interval + int(bound)
+        #: the run's global end interval — a self-healing restart aims at
+        #: the SAME end, not `bound` more intervals (ISSUE 9)
+        self._end_interval = end
 
         prev_snap = self.registry.snapshot()
         wins = window_batches(self._stream(feed), w)
@@ -283,6 +299,8 @@ class ContinualTrainer:
                                "batches_consumed":
                                    (interval + 1) * cfg.snapshot_every * w})
                     self._c_checkpoints.inc()
+                    self.batches_consumed = \
+                        (interval + 1) * cfg.snapshot_every * w
                 entry = self.gate.decide(verdict, interval=interval)
                 if entry["deploy"]:
                     # the deploy (and only the deploy) pays the full
@@ -310,11 +328,25 @@ class ContinualTrainer:
 
     # -- daemon shape -------------------------------------------------------
     def start(self, feed: Iterable, intervals: Optional[int] = None,
-              resume: bool = False) -> "ContinualTrainer":
+              resume: bool = False, max_restarts: int = 0,
+              feed_factory=None) -> "ContinualTrainer":
         """Run the loop on a daemon thread (the train-forever service
-        shape); ``stop()`` ends it at the next window edge."""
+        shape); ``stop()`` ends it at the next window edge.
+
+        Self-healing (ISSUE 9): ``max_restarts > 0`` lets the daemon
+        survive a crash mid-stream — the loop restarts with
+        ``resume=True``, picking up variables/optimizer/rng from the
+        latest checkpoint (``checkpoint_dir`` required for an exact
+        resume; without one a restart retrains from init), and
+        ``feed_factory(batches_consumed)`` — when given — builds a fresh
+        feed fast-forwarded to the exact recorded stream offset (one
+        interval is a fixed batch count, so the checkpoint metadata IS
+        the offset).  Every restart is a recorded
+        ``continual.restarts`` metric."""
         if self._thread is not None:
             raise RuntimeError("continual trainer already started")
+        self._max_restarts = int(max_restarts)
+        self._feed_factory = feed_factory
         self._stop_evt.clear()
         self._thread = threading.Thread(
             target=self._run_guarded, args=(feed, intervals, resume),
@@ -323,12 +355,44 @@ class ContinualTrainer:
         return self
 
     def _run_guarded(self, feed, intervals, resume):
-        try:
-            self.run(feed, intervals=intervals, resume=resume)
-        except Exception:
-            # a dead training daemon must be loud: the serving side keeps
-            # answering with the last deployed checkpoint either way
-            get_logger(_LOG).exception("continual trainer crashed")
+        restarts = 0
+        while True:
+            try:
+                self.run(feed, intervals=intervals, resume=resume)
+                return
+            except Exception:
+                # a dead training daemon must be loud: the serving side
+                # keeps answering with the last deployed checkpoint
+                # either way
+                get_logger(_LOG).exception("continual trainer crashed")
+                if restarts >= self._max_restarts or \
+                        self._stop_evt.is_set():
+                    return
+                restarts += 1
+                self._c_restarts.inc()
+                # exact stream resume (ISSUE 9): restart from the latest
+                # checkpoint; a replayable feed is rebuilt fast-forwarded
+                # to the recorded batch offset, so no sample is trained
+                # twice and none is skipped
+                if self._feed_factory is not None:
+                    feed = self._feed_factory(self.batches_consumed)
+                resume = True
+                end = self._end_interval
+                if end is not None and self.checkpoint_dir:
+                    # a checkpointed restart resumes the interval
+                    # NUMBERING, so aim at the ORIGINAL end: remaining =
+                    # end minus what already completed.  A crash on the
+                    # final edge (everything trained, e.g. the
+                    # checkpoint write died) has nothing left to redo.
+                    if end - self.intervals_done <= 0:
+                        return
+                    intervals = end - self.intervals_done
+                # without a checkpoint_dir the restart retrains from
+                # init at interval 0 — the original bound stands
+                get_logger(_LOG).warning(
+                    "restarting continual trainer (restart %d/%d) from "
+                    "batch offset %d", restarts, self._max_restarts,
+                    self.batches_consumed)
 
     def stop(self, timeout: float = 60.0):
         """Signal the loop to end and join it; returns the final

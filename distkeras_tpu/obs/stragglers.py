@@ -64,6 +64,7 @@ class StragglerDetector:
 
     def __init__(self, k: float = 3.0, alpha: float = 0.25,
                  min_workers: int = 2, min_gap_s: float = 1e-3,
+                 weight_floor: float = 0.1,
                  registry: Optional[Registry] = None):
         if k <= 1.0:
             raise ValueError(f"straggler threshold k must exceed 1, got {k}")
@@ -74,6 +75,10 @@ class StragglerDetector:
         #: median floor: below this the fleet is too fast for a multiple
         #: of the median to mean anything (toy tests, cache-warm windows)
         self.min_gap_s = float(min_gap_s)
+        #: down-weighting floor (ISSUE 9): a flagged worker's commits are
+        #: never scaled below this — evict-and-respawn, not starvation, is
+        #: the remedy for a worker this far gone
+        self.weight_floor = float(weight_floor)
         self.registry = registry
         self._lock = threading.Lock()
         self._ewma: Dict[int, float] = {}
@@ -142,6 +147,29 @@ class StragglerDetector:
                 self.registry.gauge(
                     f"ps.heartbeat_gap_ewma.worker{w}").set(e)
         return set(self._flagged)
+
+    def commit_weight(self, worker_id) -> float:
+        """DynSGD-style down-weighting multiplier for this worker's NEXT
+        commit (ISSUE 9 rung 1): an unflagged worker commits at full
+        weight 1.0; a flagged straggler's commits are scaled by its peer
+        median over its own EWMA — a worker whose cadence is 5× the
+        fleet's contributes 1/5 of its delta, exactly the shape of
+        DynSGD's 1/(staleness+1) rule but driven by the *liveness*
+        signal instead of the update counter.  Floored at
+        ``weight_floor``; restored to 1.0 the moment the flag clears."""
+        try:
+            w = int(worker_id)
+        except (TypeError, ValueError):
+            return 1.0
+        with self._lock:
+            if w not in self._flagged:
+                return 1.0
+            ewma = self._ewma.get(w)
+            peers = [v for p, v in self._ewma.items() if p != w]
+            if not peers or not ewma or ewma <= 0:
+                return 1.0
+            median = max(statistics.median(peers), self.min_gap_s)
+            return max(self.weight_floor, min(1.0, median / ewma))
 
     @property
     def stragglers(self) -> List[int]:

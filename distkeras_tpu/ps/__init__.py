@@ -30,4 +30,4 @@ from .servers import (  # noqa: F401
     ParameterServer,
     SocketParameterServer,
 )
-from .client import PSClient  # noqa: F401
+from .client import PSClient, WorkerEvicted  # noqa: F401

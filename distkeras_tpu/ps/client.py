@@ -45,15 +45,27 @@ from ..obs import TIME_BUCKETS, Registry, default_registry
 from ..obs.spans import SpanTracer
 from . import codecs
 from .networking import (client_handshake, connect, pinned_wire_version,
-                         recv_msg, send_msg)
+                         recv_msg, retry_with_backoff, send_msg)
+
+
+class WorkerEvicted(RuntimeError):
+    """The PS tombstoned this incarnation's commit (its generation was
+    superseded by an eviction — ISSUE 9): a supervisor-spawned replacement
+    owns the worker id now.  The worker loop exits cleanly on this; it is
+    an eviction notice, not a failure."""
 
 
 class PSClient:
     def __init__(self, host: str, port: int, worker_id: int = 0,
                  registry: Optional[Registry] = None,
                  codec=None, wire_version: Optional[int] = None,
-                 tracer: Optional[SpanTracer] = None):
+                 tracer: Optional[SpanTracer] = None,
+                 generation: int = 0):
         self.worker_id = int(worker_id)
+        #: commit generation this incarnation runs under (ISSUE 9):
+        #: stamped on every commit so a post-eviction zombie's deltas
+        #: tombstone server-side instead of double-applying
+        self.generation = int(generation)
         self.host = host
         self.port = port
         self.registry = registry if registry is not None \
@@ -63,6 +75,8 @@ class PSClient:
         self._h_encode = self.registry.histogram("ps.codec.encode_seconds",
                                                  TIME_BUCKETS)
         self._c_reconnects = self.registry.counter("ps.client.reconnects")
+        self._c_reconnect_failures = self.registry.counter(
+            "ps.client.reconnect_failures")
         self._c_unchanged = self.registry.counter(
             "ps.client.pulls_unchanged")
         #: delta codec (``ps.codecs``) — owned here because its
@@ -91,21 +105,37 @@ class PSClient:
             self.sock, registry=self.registry, worker_id=self.worker_id,
             want=self._want_version)
 
-    def reconnect(self) -> None:
+    def reconnect(self, attempts: int = 6, base_delay: float = 0.1,
+                  max_delay: float = 2.0) -> None:
         """Drop the (possibly broken) connection and dial again (the
         replacement server may be older/newer: re-negotiate).  The pull
         cache is dropped too — a RESTARTED server's update counter can
         coincide with the cached one while its center differs, and an
         ``unchanged`` answer would then silently serve the old server's
-        center."""
+        center.
+
+        Retries the whole dial + handshake up to ``attempts`` times with
+        capped exponential backoff + jitter (ISSUE 9 satellite — a PS
+        restart takes seconds, and a fleet re-dialing in lockstep is a
+        thundering herd); each failed attempt counts under
+        ``ps.client.reconnect_failures``, the final one re-raises."""
         try:
             self.sock.close()
         except OSError:
             pass
         self._last_pull = None
-        self.sock = connect(self.host, self.port)
+
+        def dial():
+            # one dial per attempt: the backoff (not connect's own
+            # fixed-cadence retry loop) paces the re-dials
+            self.sock = connect(self.host, self.port, retries=1)
+            self._handshake()
+
+        retry_with_backoff(dial, attempts, base_delay, max_delay,
+                           self._c_reconnect_failures.inc,
+                           f"reconnect to {self.host}:{self.port}",
+                           "ps.client")
         self._c_reconnects.inc()
-        self._handshake()
 
     def _rpc(self, msg: dict, retry: bool = False) -> Any:
         """One framed request/response, rtt observed.  ``retry=True``
@@ -208,6 +238,7 @@ class PSClient:
                                          codecs.tree_payload_bytes(delta))
                 self._h_encode.observe(time.perf_counter() - t0)
             msg = {"action": "commit", "worker_id": self.worker_id,
+                   "gen": self.generation,
                    "delta": delta, "codec": self.codec.name}
             trace = self._trace_header()
             if trace is not None:
@@ -221,6 +252,12 @@ class PSClient:
             # (it did NOT apply the delta) — that must surface as a
             # failure to the worker's retry policy, never as success
             self._raise_on_error("commit", resp)
+            if resp.get("evicted"):
+                # the PS tombstoned this commit: a newer incarnation owns
+                # the worker id — this one's loop must wind down (ISSUE 9)
+                raise WorkerEvicted(
+                    f"worker {self.worker_id} generation "
+                    f"{self.generation} evicted by the PS")
             return not resp.get("dropped", False)
 
     def stats(self) -> dict:

@@ -31,7 +31,12 @@ peers.  ISSUE 5 adds two: a ``trace`` header (``trace_id``/``parent_span``
 ``gap_s`` (the worker's heartbeat gap, feeding the server's straggler
 detector) which rides EVERY commit regardless of wire version — straggler
 visibility matters most for the legacy-pinned fleets most likely to
-contain one; old servers ignore it.
+contain one; old servers ignore it.  ISSUE 9 adds ``gen`` (the worker
+incarnation's commit generation — the server tombstones commits from
+generations it has evicted; old servers ignore it and old workers imply
+generation 0) and a process-wide fault-injection seam
+(:func:`set_fault_hook`) the chaos harness uses to inject socket resets
+and timeouts into the negotiation and commit paths.
 
 Instrumented (ISSUE 2): every framed send/recv counts messages and wire
 bytes (frame header included) into an ``obs.Registry`` — the component's
@@ -65,6 +70,68 @@ WIRE_VERSION = 2
 _IOV_CHUNK = 256
 
 
+# ---------------------------------------------------------------------------
+# fault-injection seam (ISSUE 9: the chaos harness's socket-level hook)
+# ---------------------------------------------------------------------------
+
+#: process-wide chaos hook (``distkeras_tpu.chaos.SocketFaults`` installs
+#: one): called at the wire's choke points — ``("connect", None)`` before
+#: each dial, ``("handshake", None)`` entering the v1/v2 negotiation,
+#: ``("send", action)`` / ``("recv", None)`` around each framed message —
+#: and *raises* (ConnectionResetError, socket.timeout, ...) to inject the
+#: fault.  None (the default) costs one global read per message.
+_fault_hook = None
+
+
+def set_fault_hook(hook):
+    """Install (or clear, with None) the socket fault-injection hook;
+    returns the previous hook so chaos harnesses can nest/restore."""
+    global _fault_hook
+    prev = _fault_hook
+    _fault_hook = hook
+    return prev
+
+
+def _inject_fault(stage: str, action=None) -> None:
+    hook = _fault_hook
+    if hook is not None:
+        hook(stage, action)
+
+
+def backoff_delays(attempts: int, base: float = 0.1, cap: float = 2.0,
+                   jitter: float = 0.25):
+    """Capped exponential backoff with ±``jitter`` randomization — the
+    retry pacing both reconnect paths share (ISSUE 9 satellite: a fleet
+    of workers re-dialing a restarted PS in lockstep is a thundering
+    herd; jitter de-synchronizes them).  Yields ``attempts - 1`` sleep
+    durations (one per gap between attempts)."""
+    import random
+    d = float(base)
+    for _ in range(max(0, int(attempts) - 1)):
+        yield d * (1.0 + random.uniform(-jitter, jitter))
+        d = min(d * 2.0, float(cap))
+
+
+def retry_with_backoff(attempt, attempts: int, base: float, cap: float,
+                       on_failure, what: str, log_channel: str):
+    """Run ``attempt()`` up to ``attempts`` times with
+    :func:`backoff_delays` pacing — the one reconnect loop ``PSClient``
+    and ``ServeClient`` share.  ``on_failure()`` is called on EVERY
+    failed attempt (the reconnect-failure counters); the final failure
+    re-raises.  Returns ``attempt()``'s result."""
+    delays = backoff_delays(attempts, base=base, cap=cap)
+    for delay in [*delays, None]:
+        try:
+            return attempt()
+        except (ConnectionError, OSError) as e:
+            on_failure()
+            if delay is None:
+                raise
+            get_logger(log_channel).warning(
+                "%s failed (%s); retrying in %.2fs", what, e, delay)
+            time.sleep(delay)
+
+
 def determine_host_address() -> str:
     """Routable local IP via the UDP-connect trick (parity: reference
     ``distkeras/networking.py:determine_host_address``)."""
@@ -86,6 +153,7 @@ def connect(host: str, port: int, timeout: Optional[float] = 30.0,
     reg = default_registry()
     for _ in range(max(1, retries)):
         try:
+            _inject_fault("connect")
             s = socket.create_connection((host, port), timeout=timeout)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             reg.counter("net.connects").inc()
@@ -131,6 +199,7 @@ def client_handshake(sock: socket.socket, registry=None,
     want = WIRE_VERSION if want is None else int(want)
     if want < 2:
         return 1
+    _inject_fault("handshake")
     msg: dict = {"action": "hello", "versions": list(range(1, want + 1))}
     if worker_id is not None:
         msg["worker_id"] = int(worker_id)
@@ -208,6 +277,8 @@ def send_msg(sock: socket.socket, obj: Any, registry=None,
     """One framed message (parity: reference ``send_data``).  ``version=2``
     uses the zero-copy scatter-gather frame; the peer must have negotiated
     v2 (its ``recv_msg`` auto-detects either way)."""
+    _inject_fault("send", obj.get("action") if isinstance(obj, dict)
+                  else None)
     send_packed(sock, pack_msg(obj, version=version), registry=registry)
 
 
@@ -239,6 +310,7 @@ def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
 def recv_msg(sock: socket.socket, registry=None) -> Any:
     """Recv-all loop for one framed message, v1/v2 auto-detected (parity:
     reference ``recv_data``)."""
+    _inject_fault("recv")
     head = _recv_exact(sock, _LEN.size)
     reg = registry if registry is not None else default_registry()
     if head[:4] == _MAGIC2:
@@ -315,6 +387,11 @@ class FrameServer:
         self._running = threading.Event()
         self._g_conns = registry.gauge(f"{self.metric_prefix}.connections")
         self._g_inflight = registry.gauge(f"{self.metric_prefix}.inflight")
+        #: transient accept-loop errors survived (ISSUE 9 satellite:
+        #: EMFILE under fd pressure / ECONNABORTED used to silently end
+        #: the server's ability to take connections)
+        self._c_accept_errors = registry.counter(
+            f"{self.metric_prefix}.accept_errors")
 
     # -- subclass hooks -----------------------------------------------------
     def handle_request(self, action, msg: dict, ver: int,
@@ -379,12 +456,30 @@ class FrameServer:
         self.stop()
 
     # -- loops --------------------------------------------------------------
+    def _accept(self):
+        """One listener accept — a seam so tests can inject EMFILE-style
+        transient errors without monkeypatching the socket object."""
+        return self._sock.accept()
+
     def _accept_loop(self):
+        log = get_logger(f"{self.metric_prefix}.server")
         while self._running.is_set():
             try:
-                conn, _ = self._sock.accept()
-            except OSError:
-                return  # listener closed by stop()
+                conn, _ = self._accept()
+            except OSError as e:
+                # stop() clears _running BEFORE closing the listener, so
+                # a running server that sees accept fail is hitting a
+                # TRANSIENT error (EMFILE under fd pressure, ECONNABORTED
+                # on a peer that hung up mid-handshake): log, breathe,
+                # keep accepting — one bad accept must not end the
+                # server's ability to take connections (ISSUE 9).  A
+                # listener torn down under us (fd gone) is fatal.
+                if not self._running.is_set() or self._sock.fileno() < 0:
+                    return  # listener closed by stop()
+                self._c_accept_errors.inc()
+                log.warning("accept failed (transient, continuing): %s", e)
+                time.sleep(0.05)
+                continue
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._conn_lock:
                 self._conns.append(conn)

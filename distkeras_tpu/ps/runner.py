@@ -23,11 +23,14 @@ import os
 import subprocess
 import sys
 import tempfile
+import threading
 import time
+from typing import Optional
 
 import jax
 import numpy as np
 
+from ..obs.logging import get_logger
 from ..obs.spans import SpanTracer
 from ..parallel.sync import make_window_fn
 from ..utils import serde
@@ -39,6 +42,312 @@ _WORKER_CLASSES = {
     "staleness": StalenessWorker,
     "elastic": ElasticWorker,
 }
+
+
+# ---------------------------------------------------------------------------
+# fleet supervision (ISSUE 9): detect -> evict -> respawn, DURING the run
+# ---------------------------------------------------------------------------
+
+class _ThreadHandle:
+    """One thread-placement worker incarnation under supervision."""
+
+    def __init__(self, worker, attempt: int):
+        self.worker = worker
+        self.worker_id = worker.worker_id
+        self.generation = worker.generation
+        self.start_window = worker.start_window
+        self.attempt = int(attempt)
+        self.started_mono = time.monotonic()
+
+    def alive(self) -> bool:
+        return self.worker.is_alive()
+
+    def failure(self):
+        return self.worker.error
+
+    def evicted(self) -> bool:
+        return self.worker.evicted
+
+    def epoch_losses(self) -> dict:
+        return self.worker.epoch_losses
+
+    def reap(self, grace_s: float) -> None:
+        self.worker.join(grace_s)
+
+    def terminate(self) -> None:
+        """Threads cannot be killed; they are daemons and die with the
+        process (a tombstoned zombie exits at its next commit anyway)."""
+
+
+class _ProcHandle:
+    """One process-placement worker incarnation under supervision."""
+
+    def __init__(self, worker_id: int, generation: int, start_window: int,
+                 attempt: int, proc: subprocess.Popen, out_npz: str):
+        self.worker_id = int(worker_id)
+        self.generation = int(generation)
+        self.start_window = int(start_window)
+        self.attempt = int(attempt)
+        self.proc = proc
+        self.out_npz = out_npz
+        self.started_mono = time.monotonic()
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def failure(self):
+        rc = self.proc.poll()
+        return rc if rc not in (None, 0) else None
+
+    def evicted(self) -> bool:
+        # a tombstoned worker process winds down cleanly (rc 0); the
+        # supervisor already moved it out of the live set at eviction
+        return False
+
+    def epoch_losses(self) -> dict:
+        if not os.path.exists(self.out_npz):
+            return {}
+        with np.load(self.out_npz) as d:
+            return {int(name.split("_", 1)[1]): d[name] for name in d.files}
+
+    def reap(self, grace_s: float) -> None:
+        try:
+            self.proc.wait(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            pass
+
+    def terminate(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait()
+
+
+class FleetSupervisor:
+    """Live fleet watchdog: closes the PR 5 detect-only loop (ISSUE 9).
+
+    Watches every worker incarnation DURING the run — not after join —
+    and acts on three liveness signals: incarnation death with an error
+    (thread exception / nonzero process exit, which is also where
+    repeated commit-RPC failures surface, since ``commit`` never
+    auto-retries), and a heartbeat gap beyond the hard threshold (no
+    commit OR pull reaching the PS — the SIGSTOP shape).  A bad worker is
+    **evicted** (the PS bumps its commit generation, so the zombie's late
+    commits tombstone) and **respawned** through the same retry
+    machinery as before: from the current center, at the exact window
+    its commits reached (the PS per-worker counter).  ``max_attempts``
+    incarnations per worker keep the reference's Spark semantics —
+    retry once, a second failure is fatal.
+
+    :meth:`add_worker` is the same path invoked for a worker id the PS
+    has never seen: **elastic join** — a mid-run worker pulls the center
+    and starts committing, fully accounted (``ps.joins``).
+
+    The supervisor runs on the caller's thread (``run()`` blocks until
+    the fleet finishes); ``add_worker`` may be called concurrently from
+    any thread.
+    """
+
+    def __init__(self, ps, server, spawn, *, heartbeat_hard_s: float = 30.0,
+                 startup_grace_s: float = 300.0, poll_s: float = 0.05,
+                 max_attempts: int = 2, timeout: Optional[float] = None,
+                 metrics=None, placement: str = "threads"):
+        self.ps = ps
+        self.server = server
+        #: spawn(worker_id, start_window, generation, attempt) -> handle;
+        #: the placement-specific closure (thread worker / worker process)
+        self.spawn = spawn
+        self.heartbeat_hard_s = float(heartbeat_hard_s)
+        self.startup_grace_s = float(startup_grace_s)
+        self.poll_s = float(poll_s)
+        self.max_attempts = int(max_attempts)
+        self.timeout = timeout
+        self.metrics = metrics
+        self.placement = placement
+        self._lock = threading.Lock()
+        self.live: dict = {}        # worker_id -> current incarnation
+        self.attempts: dict = {}    # worker_id -> incarnations used
+        self.finished: dict = {}    # worker_id -> [retired handles]
+        self.zombies: list = []     # evicted-but-alive old incarnations
+        self._handles: list = []    # every handle ever spawned (cleanup)
+        self._log = get_logger("ps.fleet")
+
+    # -- spawning -----------------------------------------------------------
+    def _spawn_into_live(self, k: int, start_window: int, generation: int,
+                         attempt: int):
+        h = self.spawn(k, start_window, generation, attempt)
+        with self._lock:
+            self.live[k] = h
+            self.attempts[k] = self.attempts.get(k, 0) + 1
+            self._handles.append(h)
+        return h
+
+    def add_initial(self, worker_id: int, start_window: int) -> None:
+        """Start one of the run's configured workers (generation 0, or
+        whatever the PS restored for it)."""
+        with self.ps.mutex:
+            gen = self.ps.generations.get(int(worker_id), 0)
+        self._spawn_into_live(worker_id, start_window, gen, 0)
+
+    def add_worker(self, worker_id: Optional[int] = None) -> int:
+        """Elastic join (ISSUE 9): add a worker to the LIVE run.  With no
+        id, picks the next unused one.  Returns the worker id."""
+        with self._lock:
+            known = set(self.live) | set(self.finished) | set(self.attempts)
+            if worker_id is None:
+                worker_id = max(known) + 1 if known else 0
+            k = int(worker_id)
+            if k in self.live:
+                raise ValueError(f"worker {k} is already live")
+            attempt = self.attempts.get(k, 0)
+        window, gen = self.ps.register_join(k)
+        self._log.info("elastic join: worker %d enters at window %d "
+                       "(generation %d)", k, window, gen)
+        self._event("join", k, window=window)
+        self._spawn_into_live(k, window, gen, attempt)
+        return k
+
+    # -- liveness signals ---------------------------------------------------
+    def _stall_reason(self, k: int, h) -> Optional[str]:
+        """Non-None when incarnation ``h`` of worker ``k`` looks wedged:
+        nothing from it (commit or pull) has reached the PS for longer
+        than the hard threshold.  Before its first commit the startup
+        grace applies instead — interpreter start + jit compile must not
+        read as a stall (a respawn would just recompile and stall
+        again)."""
+        now = time.monotonic()
+        seen = self.server.last_seen_age(k)
+        since_start = now - h.started_mono
+        # stamps older than this incarnation belong to its predecessor
+        age = since_start if seen is None else min(seen, since_start)
+        committed = self.ps.commits_by_worker.get(k, 0) > h.start_window
+        limit = self.heartbeat_hard_s if committed \
+            else max(self.heartbeat_hard_s, self.startup_grace_s)
+        if age > limit:
+            return (f"no PS traffic for {age:.1f}s "
+                    f"(hard threshold {limit:.1f}s)")
+        return None
+
+    # -- evict / respawn ----------------------------------------------------
+    def _event(self, kind: str, worker_id: int, **fields) -> None:
+        if self.metrics is not None:
+            self.metrics.log("fleet_event", kind=kind,
+                             worker_id=int(worker_id), **fields)
+
+    def _retire(self, k: int, h, reason: str) -> int:
+        """Evict incarnation ``h``: bump the PS generation (its late
+        commits now tombstone) and move it out of the live set.  Returns
+        the window its commits reached."""
+        window = self.ps.evict_worker(k)
+        self._log.warning("evicting worker %d attempt %d (%s); commits "
+                          "reached window %d", k, h.attempt, reason, window)
+        self._event("evict", k, reason=reason, window=window)
+        with self._lock:
+            if self.live.get(k) is h:
+                del self.live[k]
+            if h.alive():
+                self.zombies.append(h)   # losses collected when it dies
+            else:
+                self.finished.setdefault(k, []).append(h)
+        return window
+
+    def _respawn_or_raise(self, k: int, failed) -> None:
+        with self._lock:
+            used = self.attempts.get(k, 0)
+        if used >= self.max_attempts:
+            # "twice" is the historical retry-once contract wording; a
+            # non-default budget or a stall-exhaustion says what really
+            # happened instead of misstating count or cause
+            times = "twice" if used == 2 else f"{used} times"
+            err = failed.failure() if failed is not None else None
+            if isinstance(err, BaseException):
+                raise RuntimeError(
+                    f"async worker {k} failed {times}") from err
+            if err is not None:  # a worker process's exit code
+                raise RuntimeError(
+                    f"async worker process {k} failed {times} (rc={err})")
+            raise RuntimeError(
+                f"async worker {k} failed {times} (last incarnation "
+                f"evicted: stalled past the heartbeat hard threshold)")
+        start, gen = self.ps.register_respawn(k)
+        self._log.warning("respawning worker %d (attempt %d) from the "
+                          "current center at window %d, generation %d",
+                          k, used, start, gen)
+        self._event("respawn", k, window=start, attempt=used)
+        self._spawn_into_live(k, start, gen, used)
+
+    # -- the watch loop -----------------------------------------------------
+    def run(self) -> dict:
+        """Supervise until every live worker finishes; returns
+        ``{worker_id: merged epoch_losses}`` across incarnations."""
+        deadline = None if self.timeout is None \
+            else time.monotonic() + float(self.timeout)
+        while True:
+            with self._lock:
+                live = dict(self.live)
+            if not live:
+                break
+            for k, h in live.items():
+                with self._lock:
+                    if self.live.get(k) is not h:
+                        continue  # replaced by a concurrent join
+                if h.alive():
+                    reason = self._stall_reason(k, h)
+                    if reason is not None:
+                        self._retire(k, h, reason)
+                        self._respawn_or_raise(k, None)
+                elif h.failure() is not None:
+                    self._retire(k, h, f"failed: {h.failure()!r}")
+                    self._respawn_or_raise(k, h)
+                else:
+                    # clean exit (evicted zombies never sit in live —
+                    # _retire moved them out before the replacement spawn)
+                    with self._lock:
+                        del self.live[k]
+                        self.finished.setdefault(k, []).append(h)
+            if deadline is not None and time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"async fleet timed out after {self.timeout:.0f}s")
+            time.sleep(self.poll_s)
+        self._reap_zombies()
+        return self._merged_losses()
+
+    def _reap_zombies(self) -> None:
+        """Give evicted-but-alive incarnations a short grace to wind down
+        (a tombstoned commit exits them) and fold in whatever complete
+        epochs they produced; one still wedged (SIGSTOP never lifted)
+        forfeits its losses — its replacement re-trained the windows that
+        mattered."""
+        with self._lock:
+            zombies = list(self.zombies)
+        for h in zombies:
+            h.reap(2.0)
+            if h.alive():
+                self._log.warning(
+                    "evicted worker %d attempt %d still wedged at fleet "
+                    "shutdown; its local losses are forfeit", h.worker_id,
+                    h.attempt)
+                continue
+            with self._lock:
+                self.finished.setdefault(h.worker_id, []).append(h)
+
+    def _merged_losses(self) -> dict:
+        out = {}
+        with self._lock:
+            finished = {k: list(v) for k, v in self.finished.items()}
+        for k, handles in finished.items():
+            d: dict = {}
+            for h in sorted(handles, key=lambda h: h.attempt):
+                d.update(h.epoch_losses())
+            out[k] = d
+        return out
+
+    def terminate_all(self) -> None:
+        """Kill every process incarnation still running (the runner's
+        finally — a hung worker must not orphan the run)."""
+        with self._lock:
+            handles = list(self._handles)
+        for h in handles:
+            h.terminate()
 
 
 class _StreamPlan:
@@ -175,6 +484,30 @@ def run_async_training(trainer, dataset, fault_injector=None,
 # thread placement (in-process, one device per worker)
 # ---------------------------------------------------------------------------
 
+def _supervisor_for(trainer, ps, server, spawn, placement: str,
+                    timeout: Optional[float] = None) -> FleetSupervisor:
+    """Build the fleet supervisor from the trainer's knobs (ISSUE 9)."""
+    return FleetSupervisor(
+        ps, server, spawn, placement=placement, timeout=timeout,
+        heartbeat_hard_s=getattr(trainer, "heartbeat_hard_s", 30.0),
+        startup_grace_s=getattr(trainer, "startup_grace_s", 300.0),
+        metrics=trainer.metrics)
+
+
+def _supervise(trainer, sup: FleetSupervisor, start_windows) -> list:
+    """Start the configured fleet, watch it to completion, return the
+    per-worker merged epoch losses (sorted by worker id — elastic joins
+    append after the configured ids)."""
+    trainer._supervisor = sup
+    try:
+        for k in range(trainer.num_workers):
+            sup.add_initial(k, start_windows[k])
+        merged = sup.run()
+    finally:
+        trainer._supervisor = None
+    return [merged[k] for k in sorted(merged)]
+
+
 def _run_thread_workers(trainer, ps, server, mode, center, xs, ys, num_epoch,
                         start_windows, stream=None):
     loss_fn, optimizer = trainer._resolve()
@@ -187,67 +520,39 @@ def _run_thread_workers(trainer, ps, server, mode, center, xs, ys, num_epoch,
     window_fn = trainer._instrumented(window_fn, "async_window")
     worker_cls = _WORKER_CLASSES[mode]
     devices = jax.devices()
-    workers = []
-    for k in range(trainer.num_workers):
+    P = trainer.num_workers
+
+    def spawn(k: int, start_window: int, generation: int, attempt: int):
+        """One worker incarnation: initial fleet, supervisor respawn, and
+        elastic join all come through here — every incarnation starts
+        from the CURRENT center (identical to the configured start for
+        attempt 0: no commits have landed yet).  The retry seed rule is
+        the historical one (seed+1+k, retries at +100 per attempt)."""
         dev = devices[k % len(devices)]
-        kw = {}
-        if worker_cls is ElasticWorker:
-            kw["alpha"] = trainer.alpha
-        variables = jax.device_put(center, dev)
-        opt_state = jax.device_put(optimizer.init(center["params"]), dev)
-        rng = jax.device_put(
-            jax.random.PRNGKey(trainer.seed + 1 + k), dev)
-        w = worker_cls(k, window_fn, variables, opt_state, rng,
-                       "127.0.0.1", server.port, num_epoch,
-                       device=dev, start_window=start_windows[k],
-                       metrics=trainer.metrics,
-                       comm_codec=getattr(trainer, "comm_codec", "none"),
-                       profile_memory=trainer.profile.memory,
-                       **kw)
-        if stream is not None:
-            w.set_stream(stream.factory(k), stream.n_windows)
-        else:
-            w.set_data(xs[k], ys[k])
-        workers.append(w)
-    for w in workers:
-        w.start()
-    for w in workers:
-        w.join()
-    # failed-task retry, the reference's implicit Spark behavior
-    # (SURVEY.md §3.1: a failed executor task is rescheduled): re-run each
-    # failed worker ONCE from the current center, continuing from the exact
-    # window its commits reached (the PS's per-worker counter); a second
-    # failure is fatal.
-    merged = [w.epoch_losses for w in workers]
-    for i, w in enumerate(workers):
-        if w.error is None:
-            continue
-        fresh_center = ps.get_model()
         kw = {"alpha": trainer.alpha} if worker_cls is ElasticWorker else {}
-        dev = w.device
-        retry = worker_cls(
-            w.worker_id, window_fn,
-            jax.device_put(fresh_center, dev),
-            jax.device_put(optimizer.init(fresh_center["params"]), dev),
+        fresh = ps.get_model()
+        w = worker_cls(
+            k, window_fn,
+            jax.device_put(fresh, dev),
+            jax.device_put(optimizer.init(fresh["params"]), dev),
             jax.device_put(jax.random.PRNGKey(
-                trainer.seed + 101 + w.worker_id), dev),
+                trainer.seed + 1 + k + 100 * attempt), dev),
             "127.0.0.1", server.port, num_epoch, device=dev,
-            start_window=ps.commits_by_worker.get(w.worker_id, 0),
-            metrics=trainer.metrics,
+            start_window=start_window, metrics=trainer.metrics,
             comm_codec=getattr(trainer, "comm_codec", "none"),
-            profile_memory=trainer.profile.memory, **kw)
+            profile_memory=trainer.profile.memory,
+            generation=generation, **kw)
         if stream is not None:
-            retry.set_stream(stream.factory(w.worker_id), stream.n_windows)
+            # elastic ids beyond the configured fleet share the partition
+            # ring (worker P trains partition 0's slice alongside it)
+            w.set_stream(stream.factory(k % stream.P), stream.n_windows)
         else:
-            retry.set_data(xs[w.worker_id], ys[w.worker_id])
-        retry.start()
-        retry.join()
-        if retry.error is not None:
-            raise RuntimeError(
-                f"async worker {w.worker_id} failed twice"
-            ) from retry.error
-        merged[i] = {**w.epoch_losses, **retry.epoch_losses}
-    return merged
+            w.set_data(xs[k % P], ys[k % P])
+        w.start()
+        return _ThreadHandle(w, attempt)
+
+    sup = _supervisor_for(trainer, ps, server, spawn, "threads")
+    return _supervise(trainer, sup, start_windows)
 
 
 # ---------------------------------------------------------------------------
@@ -295,22 +600,26 @@ def _run_process_workers(trainer, ps, server, mode, center, xs, ys,
             f"{type(trainer.loss).__name__}); loss callables cannot be "
             "shipped to worker processes")
 
+    P = trainer.num_workers
+
     def make_spec(k: int, blob: bytes, seed: int, td: str, attempt: int,
-                  start_window: int):
+                  start_window: int, generation: int):
         if stream is not None:
             # streaming workers read their shard partition straight from
             # the dataset directory (shared filesystem — the reference's
-            # executors read their partition from HDFS the same way)
+            # executors read their partition from HDFS the same way);
+            # elastic ids beyond the configured fleet share the ring
             data_spec = {"stream": {
                 "dir": stream.source.directory,
                 "num_workers": stream.P, "batch_size": stream.bs,
                 "window": stream.w, "n_windows": stream.n_windows,
                 "cols": stream.cols, "shuffle": stream.shuffle,
-                "base_seed": stream.base_seed}}
+                "base_seed": stream.base_seed},
+                "data_worker": k % stream.P}
         else:
-            data = os.path.join(td, f"data_{k}.npz")
+            data = os.path.join(td, f"data_{k % P}.npz")
             if not os.path.exists(data):
-                np.savez(data, xs=xs[k], ys=ys[k])
+                np.savez(data, xs=xs[k % P], ys=ys[k % P])
             data_spec = {"data_npz": data}
         return {
             **data_spec,
@@ -329,6 +638,7 @@ def _run_process_workers(trainer, ps, server, mode, center, xs, ys,
             "worker_id": k, "host": "127.0.0.1", "port": server.port,
             "num_epoch": num_epoch, "seed": seed,
             "start_window": int(start_window),
+            "gen": int(generation),
             "out_npz": os.path.join(td, f"out_{k}_{attempt}.npz"),
             # the worker process's OWN telemetry stream (ISSUE 6):
             # heartbeats + client-side wire spans under trace id w<k>,
@@ -339,48 +649,26 @@ def _run_process_workers(trainer, ps, server, mode, center, xs, ys,
             "attempt": attempt,
         }
 
-    def read_epochs(out_npz: str) -> dict:
-        with np.load(out_npz) as d:
-            return {int(name.split("_", 1)[1]): d[name] for name in d.files}
-
     with tempfile.TemporaryDirectory() as td:
-        specs = [make_spec(k, model_blob, trainer.seed + 1 + k, td, 0,
-                           start_windows[k])
-                 for k in range(trainer.num_workers)]
-        procs = [_spawn(s, td, k) for k, s in enumerate(specs)]
+        def spawn(k: int, start_window: int, generation: int, attempt: int):
+            """One worker-process incarnation (initial / respawn /
+            elastic join): respawns and joins ship the CURRENT center;
+            the configured fleet shares the one pre-serialized blob."""
+            blob = model_blob if (attempt == 0 and ps.num_updates == 0) \
+                else serde.serialize_model(trainer.model, ps.get_model())
+            spec = make_spec(k, blob, trainer.seed + 1 + k + 100 * attempt,
+                             td, attempt, start_window, generation)
+            proc = _spawn(spec, td, k)
+            return _ProcHandle(k, generation, start_window, attempt, proc,
+                               spec["out_npz"])
+
+        sup = _supervisor_for(trainer, ps, server, spawn, "processes",
+                              timeout=timeout)
         try:
-            for p in procs:
-                p.wait(timeout=timeout)
-            losses = []
-            # Spark-style single retry from the current center, continuing
-            # at the exact window the dead process's commits reached
-            # (thread path has the same rule)
-            for k, p in enumerate(procs):
-                if p.returncode == 0:
-                    losses.append(read_epochs(specs[k]["out_npz"]))
-                    continue
-                # epochs attempt 0 completed before dying (worker_main
-                # writes them even on failure) merge with the retry's —
-                # same rule as the thread placement
-                prior = read_epochs(specs[k]["out_npz"]) \
-                    if os.path.exists(specs[k]["out_npz"]) else {}
-                fresh = serde.serialize_model(trainer.model, ps.get_model())
-                specs[k] = make_spec(k, fresh, trainer.seed + 101 + k, td, 1,
-                                     ps.commits_by_worker.get(k, 0))
-                retry = _spawn(specs[k], td, k)
-                procs[k] = retry
-                retry.wait(timeout=timeout)
-                if retry.returncode != 0:
-                    raise RuntimeError(f"async worker process {k} failed "
-                                       f"twice (rc={retry.returncode})")
-                losses.append({**prior,
-                               **read_epochs(specs[k]["out_npz"])})
+            losses = _supervise(trainer, sup, start_windows)
         finally:
-            # a hung/failed worker must not orphan its siblings
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
-                    p.wait()
+            # a hung/failed/wedged worker must not orphan its siblings
+            sup.terminate_all()
             # fold every worker process's telemetry into the trainer's
             # sink (failure paths included — the heartbeats are exactly
             # what the postmortem wants) BEFORE the tempdir vanishes

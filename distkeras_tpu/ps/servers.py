@@ -61,7 +61,17 @@ class ParameterServer:
     """Base (reference ``ParameterServer``): holds the center variable and
     the update counter.  Optionally checkpoints the center every
     ``checkpoint_every`` commits (SURVEY.md §5.4 — persistence the
-    reference lacked)."""
+    reference lacked).
+
+    Fleet lifecycle (ISSUE 9): every worker id carries a **generation** —
+    bumped by :meth:`evict_worker` when the supervisor declares the
+    incarnation dead.  A commit stamped with a stale generation is
+    **tombstoned**: counted (``ps.commits_tombstoned``), never applied —
+    so a SIGCONT'd zombie or a delayed socket can never double-apply a
+    window its replacement already re-trained.  Respawns and elastic
+    joins register through :meth:`register_respawn` /
+    :meth:`register_join`, which hand back the exact window (= the
+    per-worker commit count) the new incarnation resumes from."""
 
     def __init__(self, center: Tree, num_workers: int = 1,
                  checkpoint_manager=None, checkpoint_every: int = 0,
@@ -74,6 +84,15 @@ class ParameterServer:
         #: window), so a restored snapshot tells each worker exactly which
         #: window to continue from (SURVEY.md §5.4).
         self.commits_by_worker: dict = {}
+        #: fleet lifecycle state (ISSUE 9), every touch under ``mutex``:
+        #: worker -> current commit generation (evictions bump it) and the
+        #: per-worker eviction/respawn/join/tombstone tallies the live
+        #: ``stats`` RPC surfaces
+        self.generations: dict = {}
+        self.tombstoned_by_worker: dict = {}
+        self.evictions_by_worker: dict = {}
+        self.respawns_by_worker: dict = {}
+        self.joins_by_worker: dict = {}
         self.mutex = threading.Lock()
         self.checkpoint_manager = checkpoint_manager
         self.checkpoint_every = int(checkpoint_every)
@@ -83,6 +102,10 @@ class ParameterServer:
         self.registry = registry if registry is not None else Registry()
         self._c_commits = self.registry.counter("ps.commits")
         self._c_pulls = self.registry.counter("ps.pulls")
+        self._c_tombstoned = self.registry.counter("ps.commits_tombstoned")
+        self._c_evictions = self.registry.counter("ps.evictions")
+        self._c_respawns = self.registry.counter("ps.respawns")
+        self._c_joins = self.registry.counter("ps.joins")
         self._h_apply = self.registry.histogram("ps.apply_seconds",
                                                 TIME_BUCKETS)
 
@@ -90,18 +113,37 @@ class ParameterServer:
     def apply_commit(self, delta: Tree, meta: dict) -> None:  # dklint: holds=mutex
         """Apply one commit to the center.  Contract: ``handle_commit``
         calls this with ``self.mutex`` held — implementations read and
-        replace shared state without re-locking."""
+        replace shared state without re-locking.  Implementations fold
+        :meth:`_commit_scale` into their update so a down-weighted
+        straggler's delta lands scaled (ISSUE 9)."""
         raise NotImplementedError
 
-    def handle_commit(self, delta: Tree, meta: dict) -> None:
+    @staticmethod
+    def _commit_scale(meta: dict) -> float:  # dklint: holds=mutex
+        """Flag-aware down-weighting multiplier the front-end attached
+        (``commit_weight`` — 1.0 for healthy workers); every update rule
+        multiplies its own scale by this."""
+        return float(meta.get("commit_weight", 1.0))
+
+    def handle_commit(self, delta: Tree, meta: dict) -> bool:
+        """Apply one commit; returns True when applied, False when the
+        commit's generation is stale (a tombstoned zombie commit)."""
         snapshot = None
         t0 = time.perf_counter()
         with self.mutex:
-            self.apply_commit(delta, meta)
-            self.num_updates += 1
             w = meta.get("worker_id")
             if w is not None:
                 w = int(w)
+                if int(meta.get("gen", 0)) < self.generations.get(w, 0):
+                    # stale incarnation: its replacement already owns this
+                    # window range — record, never apply (ISSUE 9)
+                    self.tombstoned_by_worker[w] = \
+                        self.tombstoned_by_worker.get(w, 0) + 1
+                    self._c_tombstoned.inc()
+                    return False
+            self.apply_commit(delta, meta)
+            self.num_updates += 1
+            if w is not None:
                 self.commits_by_worker[w] = self.commits_by_worker.get(w, 0) + 1
             if (self.checkpoint_manager is not None and self.checkpoint_every
                     and self.num_updates % self.checkpoint_every == 0):
@@ -118,6 +160,53 @@ class ParameterServer:
             self.checkpoint_manager.save(
                 n, center, {"num_updates": n,
                             "commits_by_worker": by_worker})
+        return True
+
+    # -- fleet lifecycle (ISSUE 9) ------------------------------------------
+    def evict_worker(self, worker_id) -> int:
+        """Declare worker ``worker_id``'s current incarnation dead: bump
+        its generation so any late commit from it tombstones.  Returns the
+        window its commits reached — the replacement's exact resume
+        point."""
+        w = int(worker_id)
+        with self.mutex:
+            self.generations[w] = self.generations.get(w, 0) + 1
+            self.evictions_by_worker[w] = \
+                self.evictions_by_worker.get(w, 0) + 1
+            window = self.commits_by_worker.get(w, 0)
+        self._c_evictions.inc()
+        return window
+
+    def register_respawn(self, worker_id) -> tuple:
+        """A replacement incarnation for an evicted worker: returns
+        ``(start_window, generation)`` it must run under."""
+        w = int(worker_id)
+        with self.mutex:
+            self.respawns_by_worker[w] = self.respawns_by_worker.get(w, 0) + 1
+            out = (self.commits_by_worker.get(w, 0),
+                   self.generations.get(w, 0))
+        self._c_respawns.inc()
+        return out
+
+    def register_join(self, worker_id) -> tuple:
+        """Elastic join: a worker id joining the live run (never seen, or
+        returning after a completed run).  Returns ``(start_window,
+        generation)`` — the same resume contract as a respawn."""
+        w = int(worker_id)
+        with self.mutex:
+            self.joins_by_worker[w] = self.joins_by_worker.get(w, 0) + 1
+            out = (self.commits_by_worker.get(w, 0),
+                   self.generations.get(w, 0))
+        self._c_joins.inc()
+        return out
+
+    def fleet_snapshot(self) -> dict:  # dklint: holds=mutex
+        """Plain-data fleet lifecycle state; caller holds ``mutex``."""
+        return {"generations": dict(self.generations),
+                "tombstoned_by_worker": dict(self.tombstoned_by_worker),
+                "evictions_by_worker": dict(self.evictions_by_worker),
+                "respawns_by_worker": dict(self.respawns_by_worker),
+                "joins_by_worker": dict(self.joins_by_worker)}
 
     def restore(self, checkpoint_manager) -> bool:
         """Load the latest center checkpoint; returns True if restored."""
@@ -142,9 +231,11 @@ class ParameterServer:
         with self.mutex:
             num_updates = self.num_updates
             by_worker = dict(self.commits_by_worker)
+            fleet = self.fleet_snapshot()
         return {"stats": self.registry.snapshot(),
                 "num_updates": num_updates,
                 "commits_by_worker": by_worker,
+                "fleet": fleet,
                 "server": type(self).__name__,
                 "num_workers": self.num_workers}
 
@@ -160,7 +251,8 @@ class DeltaParameterServer(ParameterServer):
     Parity: reference ``DeltaParameterServer``."""
 
     def apply_commit(self, delta, meta):  # dklint: holds=mutex
-        self.center = _tree_fused_add(self.center, delta, 1.0)
+        self.center = _tree_fused_add(self.center, delta,
+                                      self._commit_scale(meta))
 
 
 class ADAGParameterServer(ParameterServer):
@@ -170,7 +262,8 @@ class ADAGParameterServer(ParameterServer):
 
     def apply_commit(self, delta, meta):  # dklint: holds=mutex
         self.center = _tree_fused_add(self.center, delta,
-                                      1.0 / self.num_workers)
+                                      self._commit_scale(meta)
+                                      / self.num_workers)
 
 
 class DynSGDParameterServer(ParameterServer):
@@ -212,8 +305,11 @@ class DynSGDParameterServer(ParameterServer):
         w = meta.get("worker_id")
         if w is not None:
             self._worker_hist(int(w)).observe(staleness)
+        # staleness- AND flag-aware (ISSUE 9): a flagged straggler's
+        # commit is scaled by both rules at once
         self.center = _tree_fused_add(self.center, delta,
-                                      1.0 / (staleness + 1))
+                                      self._commit_scale(meta)
+                                      / (staleness + 1))
 
 
 class SocketParameterServer(FrameServer):
@@ -274,6 +370,13 @@ class SocketParameterServer(FrameServer):
         #: pack_msg payload); every touch goes through _cache_lock
         self._pull_cache: dict = {}
         self._cache_lock = threading.Lock()
+        #: per-worker liveness (ISSUE 9): worker -> monotonic stamp of its
+        #: last commit/pull, and the last commit-weight gauge value set —
+        #: both written by handler threads, every touch under _seen_lock
+        self._last_seen: dict = {}
+        self._weights: dict = {}
+        self._seen_lock = threading.Lock()
+        self._c_requests = ps.registry.counter("ps.commit_requests")
         self._c_dropped = ps.registry.counter("ps.commits_dropped")
         self._c_unchanged = ps.registry.counter("ps.pulls_unchanged")
         self._c_cache_hits = ps.registry.counter("ps.pull_cache_hits")
@@ -334,12 +437,45 @@ class SocketParameterServer(FrameServer):
         self._h_decode.observe(time.perf_counter() - t0)
         return delta
 
+    def _touch(self, worker_id) -> None:
+        """Refresh this worker's liveness stamp (commit AND pull traffic
+        both count: a worker blocked in compute still pulled recently;
+        one truly wedged — SIGSTOP, dead socket — goes silent on both)."""
+        if worker_id is None:
+            return
+        now = time.monotonic()
+        with self._seen_lock:
+            self._last_seen[int(worker_id)] = now
+
+    def last_seen_age(self, worker_id) -> Optional[float]:
+        """Seconds since this worker's last commit/pull; None if it never
+        reached the server — the supervisor's liveness source."""
+        with self._seen_lock:
+            t = self._last_seen.get(int(worker_id))
+        return None if t is None else time.monotonic() - t
+
+    def _commit_weight(self, worker_id) -> float:
+        """Down-weighting multiplier for this commit (ISSUE 9 rung 1),
+        every CHANGE recorded as a ``ps.commit_weight.worker<k>`` gauge —
+        the restore to 1.0 when the flag clears included."""
+        if worker_id is None:
+            return 1.0
+        w = int(worker_id)
+        weight = self.stragglers.commit_weight(w)
+        with self._seen_lock:
+            changed = self._weights.get(w) != weight
+            self._weights[w] = weight
+        if changed:
+            self.ps.registry.gauge(f"ps.commit_weight.worker{w}").set(weight)
+        return weight
+
     def handle_request(self, action, msg: dict, ver: int,
                        conn: socket.socket):
         """PS protocol body on the shared frame (``hello``/``stop``/
         errors live in ``FrameServer``)."""
         if action == "pull":
             with self._remote_span("ps.serve_pull", msg):
+                self._touch(msg.get("worker_id"))
                 have = msg.get("have")
                 center, updates = self.ps.pull()
                 if have is not None and int(have) == updates:
@@ -349,6 +485,10 @@ class SocketParameterServer(FrameServer):
                             registry=self.ps.registry)
                 return REPLY_SENT
         if action == "commit":
+            # every commit REQUEST counts before any outcome branches, so
+            # requests == applied + dropped + tombstoned always holds
+            self._c_requests.inc()
+            self._touch(msg.get("worker_id"))
             # liveness first: a dropped commit is still a heartbeat — the
             # fault injector models a lost UPDATE, not a dead worker
             if msg.get("gap_s") is not None:
@@ -356,15 +496,30 @@ class SocketParameterServer(FrameServer):
                                        msg.get("gap_s"))
             dropped = bool(self.fault_injector and
                            self.fault_injector("commit", msg))
+            applied = True
             if not dropped:
+                weight = self._commit_weight(msg.get("worker_id"))
+                if weight != 1.0:
+                    msg["commit_weight"] = weight
                 delta = self._decoded_delta(msg)
                 with self._remote_span("ps.apply", msg):
-                    self.ps.handle_commit(delta, msg)
+                    applied = self.ps.handle_commit(delta, msg)
             else:
                 self._c_dropped.inc()
-            return {"ok": True, "dropped": dropped}
+            reply = {"ok": True, "dropped": dropped}
+            if not applied:
+                # stale generation: tell the zombie it was evicted so it
+                # can wind down instead of burning its slice forever
+                reply["tombstoned"] = True
+                reply["evicted"] = True
+            return reply
         if action == "stats":
             reply = self.ps.stats()
             reply["stragglers"] = self.stragglers.snapshot()
+            now = time.monotonic()
+            with self._seen_lock:
+                seen = dict(self._last_seen)
+            reply.setdefault("fleet", {})["last_seen_age_s"] = {
+                w: now - t for w, t in seen.items()}
             return reply
         return None

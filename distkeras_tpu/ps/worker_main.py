@@ -87,15 +87,20 @@ def run_spec(spec_path: str) -> None:
         spec["host"], int(spec["port"]), int(spec["num_epoch"]),
         start_window=int(spec.get("start_window", 0)),
         comm_codec=spec.get("comm_codec", "none"), metrics=metrics,
-        profile_memory=bool(spec.get("profile_memory", True)), **kw)
+        profile_memory=bool(spec.get("profile_memory", True)),
+        generation=int(spec.get("gen", 0)), **kw)
     if "stream" in spec:
         # disk-streaming partition: this process reads ITS shards straight
-        # from the (shared) dataset directory — nothing was staged for it
+        # from the (shared) dataset directory — nothing was staged for it.
+        # ``data_worker`` decouples the partition index from the PS
+        # identity (an elastic-joined id beyond the configured fleet
+        # shares the partition ring — ISSUE 9)
         from ..data.streaming import ShardedFileDataset, worker_window_factory
         s = spec["stream"]
         factory = worker_window_factory(
             ShardedFileDataset(s["dir"]), list(s["cols"]),
-            int(s["batch_size"]), int(spec["worker_id"]),
+            int(s["batch_size"]),
+            int(spec.get("data_worker", spec["worker_id"])),
             int(s["num_workers"]), int(s["window"]), int(s["base_seed"]),
             bool(s["shuffle"]))
         worker.set_stream(factory, int(s["n_windows"]))

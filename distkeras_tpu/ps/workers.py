@@ -21,6 +21,7 @@ staleness.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Callable, Optional
@@ -31,7 +32,7 @@ import numpy as np
 from ..obs import profile as obs_profile
 from ..obs.spans import SpanTracer
 from ..parallel.sync import _inexact, adopt_float_leaves, tmap as _tmap
-from .client import PSClient
+from .client import PSClient, WorkerEvicted
 
 Tree = Any
 
@@ -54,9 +55,17 @@ class AsyncWorker(threading.Thread):
                  variables: Tree, opt_state: Tree, rng,
                  host: str, port: int, num_epoch: int,
                  device=None, start_window: int = 0, metrics=None,
-                 comm_codec: str = "none", profile_memory: bool = True):
+                 comm_codec: str = "none", profile_memory: bool = True,
+                 generation: int = 0):
         super().__init__(name=f"worker-{worker_id}", daemon=True)
         self.worker_id = worker_id
+        #: commit generation this incarnation runs under (ISSUE 9): the
+        #: supervisor bumps it on eviction, so a zombie predecessor's
+        #: late commits tombstone instead of double-applying
+        self.generation = int(generation)
+        #: True when the PS evicted this incarnation (a replacement owns
+        #: the id): a CLEAN exit, distinct from ``error``
+        self.evicted = False
         self.window_fn = window_fn
         self.variables = variables
         self.opt_state = opt_state
@@ -120,11 +129,16 @@ class AsyncWorker(threading.Thread):
             self.tracer.set_trace_id(f"w{self.worker_id}")
             self._last_commit_mono = time.monotonic()
             client = PSClient(self.ps_host, self.ps_port, self.worker_id,
-                              codec=self.comm_codec, tracer=self.tracer)
+                              codec=self.comm_codec, tracer=self.tracer,
+                              generation=self.generation)
             try:
                 self._train(client)
             finally:
                 client.close()
+        except WorkerEvicted:
+            # eviction notice, not a failure: the supervisor's replacement
+            # owns this worker id — wind down without burning the slice
+            self.evicted = True
         except BaseException as e:  # surfaced by the runner after join()
             self.error = e
 
@@ -213,6 +227,13 @@ class AsyncWorker(threading.Thread):
                          mean_loss=float(np.mean(losses)), **extra)
 
     def _run_window(self, wx, wy):
+        # slow-motion throttle for the chaos harness / contention benches
+        # (ISSUE 9): toy windows finish in ms, far too fast to inject a
+        # mid-run fault deterministically — a per-window sleep stretches
+        # the run without changing any numerics.  Off (0) in production.
+        delay = float(os.environ.get("DKTPU_WINDOW_DELAY_S", 0) or 0)
+        if delay > 0:
+            time.sleep(delay)
         self.variables, self.opt_state, self.rng, losses = self.window_fn(
             self.variables, self.opt_state, self.rng, wx, wy)
         return losses

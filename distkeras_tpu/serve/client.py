@@ -27,8 +27,9 @@ from typing import Any, Optional
 import numpy as np
 
 from ..obs import TIME_BUCKETS, Registry, default_registry
-from ..ps.networking import (client_handshake, connect, pinned_wire_version,
-                             recv_msg, send_msg)
+from ..ps.networking import (client_handshake, connect,
+                             pinned_wire_version, recv_msg,
+                             retry_with_backoff, send_msg)
 
 
 class ServeClient:
@@ -45,6 +46,8 @@ class ServeClient:
         self._c_rejected = self.registry.counter("serve.client.rejected")
         self._c_reconnects = self.registry.counter(
             "serve.client.reconnects")
+        self._c_reconnect_failures = self.registry.counter(
+            "serve.client.reconnect_failures")
         #: ``None`` negotiates; ``1`` pins legacy (also via DKTPU_WIRE=1)
         self._want_version = pinned_wire_version(wire_version)
         self.sock = connect(host, port)
@@ -52,16 +55,30 @@ class ServeClient:
                                              registry=self.registry,
                                              want=self._want_version)
 
-    def reconnect(self) -> None:
+    def reconnect(self, attempts: int = 6, base_delay: float = 0.1,
+                  max_delay: float = 2.0) -> None:
+        """Re-dial + re-negotiate with capped exponential backoff +
+        jitter (ISSUE 9 satellite — same policy as ``PSClient``): a
+        draining/restarting service takes seconds to come back, and a
+        client pool re-dialing in lockstep is a thundering herd.  Each
+        failed attempt counts under ``serve.client.reconnect_failures``;
+        the final one re-raises."""
         try:
             self.sock.close()
         except OSError:
             pass
-        self.sock = connect(self.host, self.port)
+
+        def dial():
+            self.sock = connect(self.host, self.port, retries=1)
+            self.wire_version = client_handshake(
+                self.sock, registry=self.registry,
+                want=self._want_version)
+
+        retry_with_backoff(dial, attempts, base_delay, max_delay,
+                           self._c_reconnect_failures.inc,
+                           f"reconnect to {self.host}:{self.port}",
+                           "serve.client")
         self._c_reconnects.inc()
-        self.wire_version = client_handshake(self.sock,
-                                             registry=self.registry,
-                                             want=self._want_version)
 
     def _rpc(self, msg: dict, retry: bool = False) -> Any:
         try:

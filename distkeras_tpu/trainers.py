@@ -482,11 +482,24 @@ class DistributedTrainer(Trainer):
                  learning_rate: float = 0.01, seed: int = 0,
                  mode: str = "sync", mesh=None,
                  async_workers: str = "threads",
-                 comm_codec: str = "none", **kw):
+                 comm_codec: str = "none",
+                 heartbeat_hard_s: float = 30.0,
+                 startup_grace_s: float = 300.0, **kw):
         super().__init__(keras_model, worker_optimizer, loss, features_col,
                          label_col, num_epoch, batch_size, learning_rate, seed,
                          **kw)
         self.num_workers = int(num_workers)
+        #: fleet self-healing knobs (ISSUE 9, async mode): a worker whose
+        #: commits/pulls stop reaching the PS for ``heartbeat_hard_s`` is
+        #: evicted and respawned by the live supervisor;
+        #: ``startup_grace_s`` applies instead until an incarnation's
+        #: first commit (interpreter start + jit compile must not read as
+        #: a stall)
+        self.heartbeat_hard_s = float(heartbeat_hard_s)
+        self.startup_grace_s = float(startup_grace_s)
+        #: live fleet supervisor, set only while an async run is in
+        #: flight — the ``add_worker`` elastic-join seam
+        self._supervisor = None
         self.communication_window = int(
             communication_window if communication_window is not None
             else self._default_window)
@@ -513,6 +526,20 @@ class DistributedTrainer(Trainer):
             comm_codec = comm_codec.name
         get_codec(comm_codec)  # validate the spec at construction time
         self.comm_codec = comm_codec
+
+    # -- fleet elasticity (ISSUE 9) -----------------------------------------
+    def add_worker(self, worker_id=None) -> int:
+        """Elastic join: add a worker to the LIVE async run (``train()``
+        currently blocking on another thread).  The new worker pulls the
+        current center and starts committing, fully accounted by the PS
+        (``ps.joins``).  With no id, the next unused one is picked.
+        Returns the worker id."""
+        sup = self._supervisor
+        if sup is None:
+            raise RuntimeError(
+                "no live async run to join — add_worker() is valid only "
+                "while train(mode='async') is in flight")
+        return sup.add_worker(worker_id)
 
     # -- algorithm hooks ----------------------------------------------------
     def _sync_algorithm(self):

@@ -274,6 +274,46 @@ def _straggler_lines(snap: dict, source: str) -> list:
     return lines
 
 
+def _fleet_lines(fleet: dict, stats: dict) -> list:
+    """Per-worker fleet liveness (ISSUE 9): last-seen age, generation,
+    eviction/respawn/join/tombstone tallies — the live view that makes a
+    stalled or self-healing fleet visible while it runs (the old
+    end-of-run-only retry path had no such window)."""
+    fleet = fleet or {}
+    ages = fleet.get("last_seen_age_s") or {}
+    gens = fleet.get("generations") or {}
+    ev = fleet.get("evictions_by_worker") or {}
+    rs = fleet.get("respawns_by_worker") or {}
+    jn = fleet.get("joins_by_worker") or {}
+    tb = fleet.get("tombstoned_by_worker") or {}
+    workers = sorted({int(w) for d in (ages, gens, ev, rs, jn, tb)
+                      for w in d}, key=int)
+    if not workers:
+        return []
+
+    def _cval(name):
+        return stats.get(name, {}).get("value", 0)
+
+    def _get(d, w):
+        return d.get(w, d.get(str(w), 0))
+
+    lines = ["== Fleet liveness ==",
+             f"evictions {_cval('ps.evictions'):.0f}   "
+             f"respawns {_cval('ps.respawns'):.0f}   "
+             f"joins {_cval('ps.joins'):.0f}   "
+             f"tombstoned commits {_cval('ps.commits_tombstoned'):.0f}",
+             f"{'worker':>6}  {'last seen':>10}  {'gen':>4}  "
+             f"{'evict':>5}  {'respawn':>7}  {'join':>4}  {'tombst':>6}"]
+    for w in workers:
+        age = _get(ages, w)
+        age_s = f"{_num(age, 0.0):.1f}s ago" if w in ages or str(w) in ages \
+            else "never"
+        lines.append(f"{w:>6}  {age_s:>10}  {_get(gens, w):>4}  "
+                     f"{_get(ev, w):>5}  {_get(rs, w):>7}  "
+                     f"{_get(jn, w):>4}  {_get(tb, w):>6}")
+    return lines
+
+
 def _top_spans(spans: list, top: int = 10) -> list:
     lines = ["== Top spans by cumulative time ==",
              f"{'span':<24} {'count':>6}  {'total':>10}  {'mean':>10}"]
@@ -429,6 +469,10 @@ def summarize_stats(reply: dict) -> str:
     if codec:
         lines.append("")
         lines.extend(codec)
+    fleet = _fleet_lines(reply.get("fleet") or {}, stats)
+    if fleet:
+        lines.append("")
+        lines.extend(fleet)
     stragglers = _straggler_lines(reply.get("stragglers") or {}, "live")
     if stragglers:
         lines.append("")

@@ -1,0 +1,396 @@
+"""Chaos harness acceptance (ISSUE 9): the self-healing async fleet
+under injected faults.
+
+The fast cases (tier-1): socket resets/timeouts into the commit and
+negotiation paths, the thread-placement virtual SIGSTOP/SIGCONT with
+tombstone accounting, mid-run elastic join, reconnect backoff, the
+accept-loop EMFILE survival, and DynSGD-style down-weighting of flagged
+stragglers.  The kill -9 / SIGSTOP process-placement acceptance run is
+marked ``slow`` (it spawns real worker processes).
+
+Every training case asserts the exact commit accounting the supervisor
+guarantees: ``requests == applied + dropped + tombstoned``.
+"""
+
+import errno
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import distkeras_tpu as dk
+from distkeras_tpu import chaos
+from distkeras_tpu.obs import Registry, StragglerDetector
+from distkeras_tpu.ps import workers as workers_mod
+from distkeras_tpu.ps.client import PSClient
+from distkeras_tpu.ps.servers import (DeltaParameterServer,
+                                      SocketParameterServer)
+from distkeras_tpu.serve.client import ServeClient
+from tests.test_trainers_sync import COMMON, accuracy, make_model, toy_problem
+
+pytestmark = pytest.mark.chaos
+
+
+def tree(v):
+    return {"params": [{"w": np.asarray(v, dtype=np.float32)}],
+            "state": [{}]}
+
+
+def _val(snap, name):
+    return snap.get(name, {}).get("value", 0)
+
+
+def _assert_commit_accounting(snap):
+    """The ISSUE 9 invariant: every commit REQUEST is accounted exactly
+    once — applied, fault-injector-dropped, or tombstoned."""
+    assert _val(snap, "ps.commit_requests") == (
+        _val(snap, "ps.commits") + _val(snap, "ps.commits_dropped")
+        + _val(snap, "ps.commits_tombstoned"))
+
+
+def _wait(cond, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out after {timeout_s}s waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# socket faults: the v1/v2 negotiation and commit paths
+# ---------------------------------------------------------------------------
+
+def test_socket_reset_on_commit_respawns_worker():
+    """A connection reset mid-commit kills the worker (commit never
+    auto-retries — resending could double-apply); the supervisor evicts
+    and respawns it live, and training completes with exact
+    accounting."""
+    ds = toy_problem(n=512)
+    t = dk.DOWNPOUR(make_model(), "sgd", num_workers=2, mode="async",
+                    communication_window=4, **COMMON)
+    with chaos.SocketFaults({"send:commit": [3]}) as faults:
+        m = t.train(ds)
+    assert faults.injected == 1
+    assert m.variables is not None
+    reg = t.ps_stats["registry"]
+    assert _val(reg, "ps.evictions") == 1
+    assert _val(reg, "ps.respawns") == 1
+    _assert_commit_accounting(reg)
+    # the reset commit never reached the server; its window was re-run by
+    # the respawn — every window applied exactly once
+    assert t.ps_stats["num_updates"] == 2 * 2 * COMMON["num_epoch"]
+    assert len(t.get_history()) == COMMON["num_epoch"]
+
+
+def test_reconnect_backoff_under_connect_faults():
+    """``PSClient.reconnect`` retries the dial + handshake with capped
+    exponential backoff instead of a single immediate attempt; every
+    failed attempt is a recorded ``ps.client.reconnect_failures``."""
+    ps = DeltaParameterServer(tree([0.0]), num_workers=1)
+    reg = Registry()
+    with SocketParameterServer(ps) as server:
+        c = PSClient("127.0.0.1", server.port, registry=reg)
+        with chaos.SocketFaults({"connect": [1, 2]}) as faults:
+            c.reconnect(base_delay=0.01)
+        assert faults.injected == 2
+        snap = reg.snapshot()
+        assert _val(snap, "ps.client.reconnect_failures") == 2
+        assert _val(snap, "ps.client.reconnects") == 1
+        assert c.commit(tree([1.0]))  # the healed connection works
+        c.close()
+    np.testing.assert_allclose(ps.get_model()["params"][0]["w"], [1.0])
+
+
+def test_reconnect_exhaustion_raises():
+    """When every backoff attempt faults, the final error surfaces (the
+    caller's retry policy owns it) and every attempt was counted."""
+    ps = DeltaParameterServer(tree([0.0]), num_workers=1)
+    reg = Registry()
+    with SocketParameterServer(ps) as server:
+        c = PSClient("127.0.0.1", server.port, registry=reg)
+        with chaos.SocketFaults({"connect": [1, 2, 3]}) as faults:
+            with pytest.raises((ConnectionError, OSError)):
+                c.reconnect(attempts=3, base_delay=0.01)
+        assert faults.injected == 3
+        assert _val(reg.snapshot(), "ps.client.reconnect_failures") == 3
+        c.close()
+
+
+def test_serve_client_reconnect_backoff_with_timeouts():
+    """``ServeClient.reconnect`` shares the backoff policy (timeout
+    flavor here; both travel the OSError paths real kernels produce).
+    The PS front-end answers the shared hello, so it stands in for the
+    decode service."""
+    ps = DeltaParameterServer(tree([0.0]), num_workers=1)
+    reg = Registry()
+    with SocketParameterServer(ps) as server:
+        c = ServeClient("127.0.0.1", server.port, registry=reg)
+        with chaos.SocketFaults({"connect": [1]}, kind="timeout") as faults:
+            c.reconnect(base_delay=0.01)
+        assert faults.injected == 1
+        snap = reg.snapshot()
+        assert _val(snap, "serve.client.reconnect_failures") == 1
+        assert _val(snap, "serve.client.reconnects") == 1
+        assert c.stats()["num_updates"] == 0  # healed and talking
+        c.close()
+
+
+def test_handshake_fault_degrades_then_recovers():
+    """A reset inside the v1/v2 hello negotiation fails that reconnect
+    attempt; the backoff's next attempt renegotiates v2 cleanly."""
+    ps = DeltaParameterServer(tree([0.0]), num_workers=1)
+    reg = Registry()
+    with SocketParameterServer(ps) as server:
+        c = PSClient("127.0.0.1", server.port, registry=reg)
+        assert c.wire_version == 2
+        with chaos.SocketFaults({"handshake": [1]}) as faults:
+            c.reconnect(base_delay=0.01)
+        assert faults.injected == 1
+        assert c.wire_version == 2  # renegotiated, not stuck on v1
+        assert _val(reg.snapshot(), "ps.client.reconnect_failures") == 1
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# accept-loop resilience (FrameServer)
+# ---------------------------------------------------------------------------
+
+def test_accept_loop_survives_transient_errors():
+    """EMFILE/ECONNABORTED in the accept loop must not end the server's
+    ability to take connections: log + brief sleep + continue, counted
+    under ``ps.accept_errors``."""
+    ps = DeltaParameterServer(tree([0.0]), num_workers=1)
+    with SocketParameterServer(ps) as server:
+        orig = server._accept
+        state = {"n": 0}
+
+        def flaky_accept():
+            if state["n"] == 0:
+                state["n"] += 1
+                raise OSError(errno.EMFILE, "too many open files")
+            return orig()
+
+        server._accept = flaky_accept
+        # first client consumes the accept call already blocked on the
+        # original seam; the second forces a loop iteration through the
+        # injected EMFILE before being accepted
+        with PSClient("127.0.0.1", server.port) as a:
+            assert a.commit(tree([1.0]))
+            with PSClient("127.0.0.1", server.port) as b:
+                assert b.commit(tree([1.0]))
+        assert state["n"] == 1
+    snap = ps.registry.snapshot()
+    assert _val(snap, "ps.accept_errors") == 1
+    np.testing.assert_allclose(ps.get_model()["params"][0]["w"], [2.0])
+
+
+# ---------------------------------------------------------------------------
+# down-weighting (rung 1): flagged stragglers commit at reduced weight
+# ---------------------------------------------------------------------------
+
+def test_straggler_commit_weight_scales_and_restores():
+    """Detector unit: a flagged worker's weight is its peer median over
+    its own EWMA (floored); it restores to exactly 1.0 when the flag
+    clears."""
+    det = StragglerDetector(k=3.0, alpha=0.9, min_gap_s=1e-4)
+    for _ in range(3):
+        det.record(0, 0.01)
+        det.record(1, 0.01)
+    assert det.commit_weight(0) == 1.0
+    det.record(2, 1.0)
+    assert det.stragglers == [2]
+    w = det.commit_weight(2)
+    assert w == pytest.approx(max(0.1, 0.01 / 1.0))
+    # recovery: fast gaps decay the EWMA below k x median -> flag clears
+    for _ in range(8):
+        det.record(2, 0.01)
+    assert det.stragglers == []
+    assert det.commit_weight(2) == 1.0
+
+
+def test_down_weighted_commits_scale_on_the_wire():
+    """End to end through the socket server: the flagged worker's delta
+    lands scaled (every adjustment a ``ps.commit_weight.worker<k>``
+    gauge), full weight restored once the flag clears."""
+    ps = DeltaParameterServer(tree([0.0]), num_workers=2)
+    det = StragglerDetector(k=3.0, alpha=0.9, min_gap_s=1e-4,
+                            registry=ps.registry)
+    with SocketParameterServer(ps, straggler_detector=det) as server:
+        with PSClient("127.0.0.1", server.port, 0) as c0, \
+                PSClient("127.0.0.1", server.port, 1) as c1:
+            c0.commit(tree([1.0]), gap_s=0.01)
+            c0.commit(tree([1.0]), gap_s=0.01)          # center: 2.0
+            # worker 1 staggers in 100x slower: flagged on THIS commit,
+            # so its delta lands at the floor weight 0.1
+            c1.commit(tree([1.0]), gap_s=1.0)           # center: 2.1
+            w1 = ps.registry.gauge("ps.commit_weight.worker1").value
+            assert w1 == pytest.approx(0.1)
+            c1.commit(tree([1.0]), gap_s=0.01)          # still flagged: 2.2
+            # EWMA decayed below 3x peer median: flag clears, restored
+            c1.commit(tree([1.0]), gap_s=0.01)          # full: 3.2
+            assert ps.registry.gauge(
+                "ps.commit_weight.worker1").value == 1.0
+    np.testing.assert_allclose(ps.get_model()["params"][0]["w"], [3.2],
+                               rtol=1e-5)
+    _assert_commit_accounting(ps.registry.snapshot())
+
+
+# ---------------------------------------------------------------------------
+# thread placement: virtual SIGSTOP/SIGCONT -> evict, respawn, tombstone
+# ---------------------------------------------------------------------------
+
+def test_thread_stall_evicts_respawns_and_tombstones():
+    """A wedged-but-alive worker (the SIGSTOP shape) is evicted on the
+    heartbeat hard threshold and respawned from its exact committed
+    window; the SIGCONT'd zombie's late commit tombstones — recorded,
+    never applied — and the accounting invariant holds."""
+    ds = toy_problem(n=512)
+    t = dk.DOWNPOUR(make_model(), "sgd", num_workers=2, mode="async",
+                    communication_window=4, heartbeat_hard_s=2.0,
+                    startup_grace_s=60.0, **COMMON)
+    out = {}
+    with chaos.ThreadStall(workers_mod.PullCommitWorker, worker_id=1,
+                           stall_after=1) as stall:
+        th = threading.Thread(
+            target=lambda: out.update(m=t.train(ds)), daemon=True)
+        th.start()
+        assert stall.wait_stalled(90), "worker 1 never hit the stall point"
+        _wait(lambda: t._supervisor is not None, 30, "the supervisor")
+        sup = t._supervisor
+        _wait(lambda: sup.ps.registry.counter("ps.evictions").value >= 1,
+              60, "the stalled worker's eviction")
+        stall.resume()  # the SIGCONT: straight into a tombstoned commit
+        th.join(180)
+    assert not th.is_alive(), "training never completed"
+    assert out["m"].variables is not None
+    reg = t.ps_stats["registry"]
+    assert _val(reg, "ps.evictions") == 1
+    assert _val(reg, "ps.respawns") == 1
+    assert _val(reg, "ps.commits_tombstoned") >= 1
+    _assert_commit_accounting(reg)
+    # the respawn resumed at window 1 (the zombie's one applied commit),
+    # so applied commits still cover every window exactly once
+    assert t.ps_stats["num_updates"] == 2 * 2 * COMMON["num_epoch"]
+    assert len(t.get_history()) == COMMON["num_epoch"]
+
+
+# ---------------------------------------------------------------------------
+# elastic join: a worker id the PS has never seen joins the live run
+# ---------------------------------------------------------------------------
+
+def test_elastic_join_contributes_accounted_commits():
+    """A worker id the PS has never seen joins the LIVE run through
+    ``trainer.add_worker()``: it pulls the current center, trains its
+    full share, and every one of its commits is PS-accounted.  Worker 0
+    is held at a stall gate while the join lands so the run is provably
+    still in flight (toy windows finish in milliseconds)."""
+    ds = toy_problem()  # 2048 samples -> 8 windows/epoch/worker
+    t = dk.DOWNPOUR(make_model(), "sgd", num_workers=2, mode="async",
+                    communication_window=4, **COMMON)
+    out = {}
+    with chaos.ThreadStall(workers_mod.PullCommitWorker, worker_id=0,
+                           stall_after=1) as stall:
+        th = threading.Thread(
+            target=lambda: out.update(m=t.train(ds)), daemon=True)
+        th.start()
+        assert stall.wait_stalled(90), "worker 0 never hit the stall gate"
+        _wait(lambda: t._supervisor is not None, 30, "the supervisor")
+        sup = t._supervisor
+        k = t.add_worker()
+        assert k == 2
+        _wait(lambda: sup.ps.commits_by_worker.get(2, 0) >= 1, 120,
+              "the joined worker's first commit")
+        stall.resume()  # release worker 0 well inside its hard threshold
+        th.join(300)
+    assert not th.is_alive(), "training never completed"
+    assert out["m"].variables is not None
+    reg = t.ps_stats["registry"]
+    assert _val(reg, "ps.joins") == 1
+    assert _val(reg, "ps.evictions") == 0
+    # the joined worker trained its FULL share, every commit accounted
+    assert t.ps_stats["commits_by_worker"][2] == 8 * COMMON["num_epoch"]
+    assert t.ps_stats["num_updates"] == 3 * 8 * COMMON["num_epoch"]
+    _assert_commit_accounting(reg)
+    assert len(t.get_history()) == COMMON["num_epoch"]
+    # outside a live run the elastic-join seam refuses loudly
+    with pytest.raises(RuntimeError, match="no live async run"):
+        t.add_worker()
+
+
+# ---------------------------------------------------------------------------
+# the full acceptance: kill -9 + SIGSTOP a process fleet, converge anyway
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_acceptance_process_fleet(monkeypatch):
+    """ISSUE 9 acceptance: 3 process-placement workers; kill -9 one and
+    SIGSTOP another mid-run; elastic-join a fourth.  Training completes,
+    converges at the async-DOWNPOUR gate, the respawns resume at the
+    exact committed windows, every lifecycle event is a recorded metric,
+    and ``jit.retraces`` stays 0 under the committed OBS_BASELINE.json
+    drift gate."""
+    import os as _os
+
+    from distkeras_tpu.obs import drift
+    from distkeras_tpu.obs.registry import Registry as _Registry
+
+    # slow-motion windows (250ms each): worker processes finish toy
+    # epochs in well under a second otherwise — the chaos must land
+    # MID-run, deterministically
+    monkeypatch.setenv("DKTPU_WINDOW_DELAY_S", "0.25")
+    ds = toy_problem()
+    t = dk.DOWNPOUR(make_model(), "sgd", num_workers=3, mode="async",
+                    async_workers="processes", communication_window=4,
+                    heartbeat_hard_s=8.0, startup_grace_s=300.0, **COMMON)
+    reg = _Registry()
+    t.tracer.registry = reg
+    out = {}
+    th = threading.Thread(
+        target=lambda: out.update(m=t.train(ds)), daemon=True)
+    th.start()
+    _wait(lambda: t._supervisor is not None, 120, "the supervisor")
+    sup = t._supervisor
+    # both victims must be mid-run: each has committed at least once and
+    # has many slow-motion windows left
+    _wait(lambda: sup.ps.commits_by_worker.get(0, 0) >= 1
+          and sup.ps.commits_by_worker.get(1, 0) >= 1, 300,
+          "first commits from workers 0 and 1")
+    with sup._lock:
+        victim = sup.live[0]
+        wedged = sup.live[1]
+    chaos.kill_worker(victim.proc)
+    stopped_pid = chaos.pause_worker(wedged.proc)
+    _wait(lambda: sup.ps.registry.counter("ps.evictions").value >= 2,
+          120, "both evictions")
+    chaos.resume_worker(stopped_pid)  # revenant -> tombstoned commit
+    k = t.add_worker()  # elastic join under fire
+    th.join(900)
+    assert not th.is_alive(), "training never completed"
+    assert out["m"].variables is not None
+    reg_ps = t.ps_stats["registry"]
+    assert _val(reg_ps, "ps.evictions") >= 2
+    assert _val(reg_ps, "ps.respawns") >= 2
+    assert _val(reg_ps, "ps.joins") == 1
+    # the SIGCONT'd revenant's late commit was tombstoned, not applied
+    assert _val(reg_ps, "ps.commits_tombstoned") >= 1
+    assert t.ps_stats["commits_by_worker"].get(k, 0) >= 1
+    _assert_commit_accounting(reg_ps)
+    # every lifecycle event also landed in the metrics stream
+    kinds = {r.get("kind") for r in t.metrics.records
+             if r.get("event") == "fleet_event"}
+    assert {"evict", "respawn", "join"} <= kinds
+    # converges under the async-DOWNPOUR gate (CONVERGENCE.md family)
+    acc = accuracy(out["m"], ds)
+    assert acc > 0.85, acc
+    # jit.retraces == 0 throughout, drift-gated against the committed
+    # baseline (zero tolerance: ANY increase is drift)
+    root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    bl = drift.load_baseline(_os.path.join(root, "OBS_BASELINE.json"))
+    reg.counter("jit.compiles")
+    reg.counter("jit.retraces")
+    doc = {"config": {"workers": 3}, "trainer": reg.snapshot()}
+    rep = drift.diff_docs(doc, doc, baseline=bl)
+    assert not rep.drifted
+    assert reg.counter("jit.retraces").value == 0

@@ -449,6 +449,51 @@ def test_partial_interval_never_reaches_the_gate(lm):
         _trainer(lm, Registry()).run(iter(batches[:2]))
 
 
+def test_daemon_restart_resumes_exact_stream(lm, tmp_path):
+    """Self-healing daemon (ISSUE 9): a crash mid-stream restarts the
+    loop from the latest checkpoint with the feed rebuilt at the EXACT
+    recorded batch offset — the interval sequence continues to the
+    original end, no sample trained twice, every restart a recorded
+    ``continual.restarts`` metric."""
+    reg = Registry()
+    trainer = _trainer(lm, reg, checkpoint_dir=str(tmp_path))
+    calls = {"n": 0}
+    orig = trainer._run_fn
+
+    def flaky(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 6:  # interval 0 checkpointed; dies inside 1
+            raise RuntimeError("injected continual crash")
+        return orig(*a, **kw)
+
+    trainer._run_fn = flaky
+    offsets = []
+
+    def feed_factory(offset):
+        # exact stream resume: a replayable feed fast-forwarded to the
+        # checkpointed batch offset (deterministic generator + skip)
+        offsets.append(offset)
+        f = synthetic_lm_feed(VOCAB, SEQ, 16, seed=0)
+        for _ in range(offset):
+            next(f)
+        return f
+
+    trainer.start(synthetic_lm_feed(VOCAB, SEQ, 16, seed=0), intervals=4,
+                  max_restarts=1, feed_factory=feed_factory)
+    trainer._thread.join(300)
+    assert not trainer._thread.is_alive(), "daemon never finished"
+    assert trainer.variables is not None
+    # one recorded restart, resumed at the exact offset the checkpoint
+    # recorded (1 interval x 4 windows x 4 steps = 16 batches)
+    assert reg.counter("continual.restarts").value == 1
+    assert offsets == [16]
+    # the interval sequence CONTINUED to the original end — 4 total, not
+    # 4-more-after-restart
+    assert trainer.intervals_done == 4
+    assert reg.counter("continual.intervals").value == 4
+    assert reg.counter("continual.checkpoints").value == 4
+
+
 def test_daemon_start_stop_trains_until_stopped(lm):
     reg = Registry()
     trainer = _trainer(lm, reg)

@@ -382,6 +382,26 @@ def test_obsview_live_ps_poll(capsys):
         assert "ps_commits_total 1" in capsys.readouterr().out
 
 
+def test_obsview_live_fleet_liveness(capsys):
+    """ISSUE 9 satellite: the live ``--ps`` view surfaces per-worker
+    liveness (last-seen age, generation, eviction/respawn/join/tombstone
+    tallies) so a stalled or self-healing fleet is visible IN-run — the
+    old end-of-run-only retry path had no such window."""
+    ps = DynSGDParameterServer(_tree([0.0]), num_workers=2)
+    with SocketParameterServer(ps) as server:
+        with PSClient("127.0.0.1", server.port, worker_id=0) as c:
+            c.commit(_tree([1.0]), last_update=0)
+        ps.evict_worker(0)
+        ps.register_respawn(0)
+        ps.register_join(1)
+        assert obsview.main(["--ps", f"127.0.0.1:{server.port}"]) == 0
+        live = capsys.readouterr().out
+    assert "Fleet liveness" in live
+    assert "evictions 1" in live and "respawns 1" in live
+    assert "joins 1" in live
+    assert "never" in live  # worker 1 joined but has not committed yet
+
+
 def test_obsview_tolerates_nonfinite_string_coercions(tmp_path):
     """A diverged run logs mean_loss=NaN; json_safe writes the string
     "NaN" — obsview must render it, not crash (it exists for exactly

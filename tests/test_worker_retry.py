@@ -1,12 +1,22 @@
 """Async worker failure → single retry (the reference's Spark task-retry
-behavior, SURVEY.md §3.1/§5.3)."""
+behavior, SURVEY.md §3.1/§5.3), now live-supervised (ISSUE 9): the
+``FleetSupervisor`` evicts and respawns DURING the run; the retry-once
+contract, the exact-window resume, and tombstone no-double-apply
+accounting are pinned here."""
 
 import numpy as np
 import pytest
 
 import distkeras_tpu as dk
 from distkeras_tpu.ps import workers as workers_mod
+from distkeras_tpu.ps.client import PSClient, WorkerEvicted
+from distkeras_tpu.ps.servers import (DeltaParameterServer,
+                                      SocketParameterServer)
 from tests.test_trainers_sync import COMMON, make_model, toy_problem
+
+
+def _val(reg_snap, name):
+    return reg_snap.get(name, {}).get("value", 0)
 
 
 def test_failed_worker_is_retried_once(monkeypatch):
@@ -43,3 +53,70 @@ def test_twice_failed_worker_raises(monkeypatch):
                     communication_window=4, **COMMON)
     with pytest.raises(RuntimeError, match="failed twice"):
         t.train(ds)
+
+
+def test_respawn_resumes_from_ps_counter(monkeypatch):
+    """The supervisor's respawn continues at the exact window the dead
+    incarnation's commits reached (the PS per-worker counter): every
+    window is committed exactly once — none retrained, none skipped —
+    and the eviction/respawn is a recorded metric."""
+    ds = toy_problem(n=512)
+    orig = workers_mod.PullCommitWorker._window
+
+    def crash_third_window(self, client, wx, wy):
+        # generation 0 only: the respawned incarnation must sail through
+        if self.worker_id == 1 and self.generation == 0 \
+                and len(self.window_losses) == 2:
+            raise RuntimeError("injected crash after 2 committed windows")
+        return orig(self, client, wx, wy)
+
+    monkeypatch.setattr(workers_mod.PullCommitWorker, "_window",
+                        crash_third_window)
+    t = dk.DOWNPOUR(make_model(), "sgd", num_workers=2, mode="async",
+                    communication_window=4, **COMMON)
+    m = t.train(ds)
+    assert m.variables is not None
+    reg = t.ps_stats["registry"]
+    assert _val(reg, "ps.evictions") == 1
+    assert _val(reg, "ps.respawns") == 1
+    assert _val(reg, "ps.commits_tombstoned") == 0  # the crash was clean
+    # exact resume accounting: 512 samples / 2 workers / 32 batch = 8
+    # steps -> 2 windows/epoch/worker; every one committed exactly once
+    total = 2 * 2 * COMMON["num_epoch"]
+    assert t.ps_stats["num_updates"] == total
+    assert _val(reg, "ps.commit_requests") == total
+    assert len(t.get_history()) == COMMON["num_epoch"]
+
+
+def test_tombstoned_commits_never_double_apply():
+    """Post-eviction commits from the stale incarnation are tombstoned —
+    recorded, never applied — and the eviction notice winds the zombie
+    client down; requests == applied + tombstoned holds exactly."""
+    def tree(v):
+        return {"params": [{"w": np.asarray(v, dtype=np.float32)}],
+                "state": [{}]}
+
+    ps = DeltaParameterServer(tree([0.0]), num_workers=2)
+    with SocketParameterServer(ps) as server:
+        with PSClient("127.0.0.1", server.port, 0, generation=0) as c0:
+            assert c0.commit(tree([1.0]))
+            # supervisor declares worker 0 dead: generation bumps, and
+            # the respawn contract hands back the exact resume window
+            assert ps.evict_worker(0) == 1
+            with pytest.raises(WorkerEvicted):
+                c0.commit(tree([1.0]))  # the zombie's late delta
+            start, gen = ps.register_respawn(0)
+            assert (start, gen) == (1, 1)
+            with PSClient("127.0.0.1", server.port, 0,
+                          generation=gen) as c1:
+                assert c1.commit(tree([1.0]))
+    # the tombstoned delta provably never landed
+    np.testing.assert_allclose(ps.get_model()["params"][0]["w"], [2.0])
+    assert ps.commits_by_worker == {0: 2}
+    snap = ps.registry.snapshot()
+    assert _val(snap, "ps.commit_requests") == 3
+    assert _val(snap, "ps.commits") == 2
+    assert _val(snap, "ps.commits_tombstoned") == 1
+    assert _val(snap, "ps.evictions") == 1
+    assert _val(snap, "ps.respawns") == 1
+    assert ps.tombstoned_by_worker == {0: 1}
