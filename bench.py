@@ -525,30 +525,42 @@ def bench_continual(intervals: int = 16, snapshot_every: int = 4,
 
 def bench_ps(codec: str = "none", windows: int = 50, mb: float = 4.0,
              out_dir: str = ROOT, wire_version=None,
-             ps_workers: int = 1) -> dict:
+             ps_workers: int = 1, ps_shards: int = 1,
+             ps_shard_placement: str = "threads") -> dict:
     """PS-comms microbenchmark (ISSUE 4 acceptance): N pull+commit windows
     against a localhost PS over an ``mb``-megabyte synthetic center, from
     ``ps_workers`` concurrent clients (ISSUE 5: the contention sweep point
     — lock/accept-thread contention is exactly what single-client RTTs
-    cannot see).
+    cannot see).  ``ps_shards > 1`` (ISSUE 10) partitions the center
+    across a shard fleet and drives it with ``ShardedPSClient`` fan-out —
+    the sweep that shows whether sharding flattens the single-lock
+    commit-RTT pileup.
 
     Returns (and the CLI prints) one JSON row: median/p99 commit RTT
     across all workers, wire bytes per window, compression ratio.  One
     MERGED registry snapshot per sweep point is written beside the
     BENCH_r*.json files — ``BENCH_PS_OBS.json`` for the single-worker
     point (the committed baseline), ``BENCH_PS_OBS_w<N>.json`` for
-    contention points — all in the same document schema obsview and the
-    drift gate read.
+    contention points (self-checked when ``OBS_BASELINE.json`` maps a
+    ``ps_bench_w<N>`` snapshot) — all in the same document schema obsview
+    and the drift gate read.
     """
     from distkeras_tpu.obs import Registry
-    from distkeras_tpu.ps import PSClient, SocketParameterServer
+    from distkeras_tpu.ps import (PSClient, ShardedParameterServer,
+                                  ShardedPSClient, SocketParameterServer)
     from distkeras_tpu.ps.servers import DeltaParameterServer
+    from distkeras_tpu.ps.shard.server import ProcessShardFleet
 
     ps_workers = int(ps_workers)
     windows = int(windows)
-    if ps_workers < 1 or windows < 1:
-        raise ValueError(f"bench_ps needs ps_workers >= 1 and windows >= 1 "
-                         f"(got {ps_workers}, {windows})")
+    ps_shards = int(ps_shards)
+    if ps_workers < 1 or windows < 1 or ps_shards < 1:
+        raise ValueError(f"bench_ps needs ps_workers, windows and "
+                         f"ps_shards >= 1 (got {ps_workers}, {windows}, "
+                         f"{ps_shards})")
+    if ps_shard_placement not in ("threads", "processes"):
+        raise ValueError(f"ps_shard_placement must be 'threads' or "
+                         f"'processes', got {ps_shard_placement!r}")
     rng = np.random.default_rng(0)
     # 8 equal fp32 leaves totalling ~mb MB — tensor-shaped like a model,
     # not one giant blob, so framing/segment overhead is realistic
@@ -558,18 +570,39 @@ def bench_ps(codec: str = "none", windows: int = 50, mb: float = 4.0,
     delta = {"params": [{"w": (0.01 * rng.normal(size=n)).astype(np.float32)}
                         for _ in range(8)], "state": [{} for _ in range(8)]}
 
-    ps = DeltaParameterServer(center, num_workers=ps_workers)
+    sharded = None
+    if ps_shards > 1 and ps_shard_placement == "processes":
+        # the deployment shape: one shard-server process per shard (the
+        # fleet stops sharing the bench interpreter's GIL — on a real
+        # deployment, one per host).  Per-shard server registries live in
+        # the shard processes; their counters are pollable via the stats
+        # RPC, so the persisted server snapshot is the merged RPC view.
+        sharded = ProcessShardFleet(center, ps_shards,
+                                    num_workers=ps_workers)
+    elif ps_shards > 1:
+        sharded = ShardedParameterServer(center, ps_shards,
+                                         DeltaParameterServer,
+                                         num_workers=ps_workers)
+    else:
+        ps = DeltaParameterServer(center, num_workers=ps_workers)
     regs = [Registry() for _ in range(ps_workers)]  # one per client thread
     rtts = [[] for _ in range(ps_workers)]
     wire_bytes = [0.0] * ps_workers
     negotiated = [1] * ps_workers
     errors: list = []
 
+    def make_client(k: int):
+        if sharded is not None:
+            return ShardedPSClient(sharded.addrs(), center, k,
+                                   registry=regs[k], codec=codec,
+                                   wire_version=wire_version)
+        return PSClient("127.0.0.1", server.port, k, registry=regs[k],
+                        codec=codec, wire_version=wire_version)
+
     def drive(k: int) -> None:
         try:
             creg = regs[k]
-            with PSClient("127.0.0.1", server.port, k, registry=creg,
-                          codec=codec, wire_version=wire_version) as client:
+            with make_client(k) as client:
                 negotiated[k] = client.wire_version
                 client.pull()  # connection + first center transfer warm
                 b0 = creg.counter("net.bytes_sent").value \
@@ -584,7 +617,10 @@ def bench_ps(codec: str = "none", windows: int = 50, mb: float = 4.0,
         except BaseException as e:  # surfaced after join — never hang
             errors.append(e)
 
-    with SocketParameterServer(ps) as server:
+    server = sharded if sharded is not None \
+        else SocketParameterServer(ps)
+    server_snap = None
+    with server:
         threads = [threading.Thread(target=drive, args=(k,),
                                     name=f"bench-ps-{k}")
                    for k in range(ps_workers)]
@@ -592,8 +628,24 @@ def bench_ps(codec: str = "none", windows: int = 50, mb: float = 4.0,
             t.start()
         for t in threads:
             t.join()
-    if errors:
-        raise errors[0]
+        if errors:
+            # surface the drive threads' own failures BEFORE the stats
+            # poll: a dead shard would otherwise mask the recorded root
+            # cause with the poller's unrelated ConnectionError
+            raise errors[0]
+        if isinstance(sharded, ProcessShardFleet):
+            # shard-process registries live across a process boundary:
+            # the merged stats-RPC view IS the server snapshot, polled
+            # while the fleet still serves
+            replies = []
+            for h, p in sharded.addrs():
+                with PSClient(h, p) as poller:
+                    replies.append(poller.stats())
+            server_snap = Registry.merge_snapshots(
+                *[r.get("stats", {}) for r in replies])
+    if server_snap is None:
+        server_snap = (sharded.registry if sharded is not None
+                       else ps.registry).snapshot()
 
     merged = Registry.merge_snapshots(*[r.snapshot() for r in regs])
 
@@ -607,9 +659,13 @@ def bench_ps(codec: str = "none", windows: int = 50, mb: float = 4.0,
     row = {
         "metric": "ps commit RTT (localhost, "
                   f"{mb:g} MB center, codec={codec}, "
-                  f"workers={ps_workers})",
+                  f"workers={ps_workers}"
+                  + (f", shards={ps_shards}" if ps_shards > 1 else "")
+                  + ")",
         "mode": "bench_ps", "codec": codec, "windows": windows,
         "ps_workers": ps_workers,
+        "ps_shards": ps_shards,
+        "ps_shard_placement": ps_shard_placement,
         "center_mb": round(mb, 3),
         "commit_rtt_ms_p50": round(float(np.median(all_rtts)) * 1e3, 3),
         "commit_rtt_ms_p99": round(float(np.quantile(all_rtts, 0.99)) * 1e3,
@@ -632,18 +688,30 @@ def bench_ps(codec: str = "none", windows: int = 50, mb: float = 4.0,
     name = os.path.basename(base_path) if ps_workers == 1 \
         else f"BENCH_PS_OBS_w{ps_workers}.json"
     snap_path = os.path.join(out_dir, name)
-    obs_doc = {"config": {k: row[k] for k in
-                          ("codec", "windows", "center_mb", "ps_workers",
-                           "wire_version")},
+    # config carries the shard keys only when sharded: the committed
+    # pre-shard baselines must keep matching un-sharded reruns exactly
+    cfg_keys = ("codec", "windows", "center_mb", "ps_workers",
+                "wire_version") + (("ps_shards", "ps_shard_placement")
+                                   if ps_shards > 1 else ())
+    obs_doc = {"config": {k: row[k] for k in cfg_keys},
                "client": merged,
-               "server": ps.registry.snapshot()}
-    # self-check + clobber guard for the single-worker baseline point;
-    # contention points get the clobber guard only (no designated
-    # baseline to check against, but a committed w<N> snapshot must not
-    # be silently replaced by a config-incompatible run either)
+               "server": server_snap}
+    if sharded is not None:
+        obs_doc["plan"] = sharded.plan.doc()
+    # self-check + clobber guard for the single-worker baseline point and
+    # for contention points with a designated ``ps_bench_w<N>`` mapping
+    # (ISSUE 10: the committed sharded w8/w16 points); unmapped contention
+    # points get the clobber guard only — a committed w<N> snapshot must
+    # not be silently replaced by a config-incompatible run either way
     if ps_workers == 1:
         row["obs_drift"], snap_path = _persist_obs_snapshot(
             snap_path, obs_doc, bl_cfg, base_path=base_path)
+    elif ((bl_cfg or {}).get("snapshots") or {}).get(
+            f"ps_bench_w{ps_workers}"):
+        wbase = _baseline_snapshot_path(bl_cfg, f"ps_bench_w{ps_workers}",
+                                        name)
+        row["obs_drift"], snap_path = _persist_obs_snapshot(
+            snap_path, obs_doc, bl_cfg, base_path=wbase)
     else:
         row["obs_drift"] = {"checked": False,
                             "reason": "no designated baseline"}
@@ -694,6 +762,17 @@ def _cli(argv=None) -> int:
                     help="bench_ps: comma-separated concurrent-client "
                          "sweep points (e.g. 1,2,4); one JSON row and one "
                          "merged registry snapshot per point")
+    ap.add_argument("--ps-shards", type=int, default=1,
+                    help="bench_ps: partition the center across N PS "
+                         "shards (ISSUE 10) — workers fan commits/pulls "
+                         "out with consistent-cut assembly; 1 = the "
+                         "single-server star")
+    ap.add_argument("--ps-shard-placement", default="threads",
+                    choices=("threads", "processes"),
+                    help="bench_ps: host shard servers in this process "
+                         "(threads) or one OS process each (processes — "
+                         "the deployment shape; shards stop sharing the "
+                         "bench interpreter's GIL)")
     args = ap.parse_args(argv)
     if sum((args.ps, args.serve, args.continual)) > 1:
         ap.error("--ps, --serve and --continual are mutually exclusive")
@@ -724,11 +803,14 @@ def _cli(argv=None) -> int:
                      f"(got {args.ps_workers!r})")
         if args.windows < 1:
             ap.error(f"--windows must be >= 1 (got {args.windows})")
+        if args.ps_shards < 1:
+            ap.error(f"--ps-shards must be >= 1 (got {args.ps_shards})")
         for n in points:
-            print(json.dumps(bench_ps(codec=args.codec,
-                                      windows=args.windows, mb=args.mb,
-                                      wire_version=args.wire,
-                                      ps_workers=n)))
+            print(json.dumps(bench_ps(
+                codec=args.codec, windows=args.windows, mb=args.mb,
+                wire_version=args.wire, ps_workers=n,
+                ps_shards=args.ps_shards,
+                ps_shard_placement=args.ps_shard_placement)))
         return 0
     main()
     return 0

@@ -20,7 +20,18 @@ Mechanics (a write-focused lockset check, in the Eraser family):
 * ``install()`` monkeypatches ``ParameterServer.__init__`` so every PS
   built afterwards gets a tracked mutex and a guarded
   ``commits_by_worker`` — the shared dict every commit path writes.
-  ``enabled()`` is the context-manager form tests use.
+  Because shard servers (``ps.shard``, ISSUE 10) ARE ``ParameterServer``
+  subclasses, a sharded center gets every shard's mutex and state dicts
+  wrapped for free.  ``enabled()`` is the context-manager form tests use.
+* **Write-after-publish detection** (ISSUE 10 satellite): the pull cache
+  (``ps.state.PullCache``) serves pre-serialized frames whose v2 buffers
+  are zero-copy views of the center's arrays — the lock-free
+  pull-snapshot contract is that commits REPLACE center arrays, never
+  mutate them after they were handed to the cache.  When installed, the
+  cache's publish hook fingerprints every published ndarray leaf, and
+  each subsequent ``handle_commit`` re-verifies them: a leaf whose bytes
+  changed after publish is a recorded violation (a torn frame some
+  puller may already be receiving).
 
 Violations land in a process-global list (thread-safe) with the dict
 name, key, thread and stack snippet — ``violations()`` / ``reset()``.
@@ -29,10 +40,13 @@ name, key, thread and stack snippet — ``violations()`` / ``reset()``.
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import os
 import threading
 import traceback
 from typing import Any, Dict, List, Optional
+
+import numpy as np
 
 ENV_VAR = "DKLINT_RACECHECK"
 
@@ -49,6 +63,7 @@ def violations() -> List[dict]:
 def reset() -> None:
     with _VLOCK:
         _VIOLATIONS.clear()
+        _PUBLISHED.clear()
 
 
 def _record_violation(name: str, op: str, key: Any) -> None:
@@ -60,6 +75,67 @@ def _record_violation(name: str, op: str, key: Any) -> None:
             "thread": threading.current_thread().name,
             "stack": stack,
         })
+
+
+# ---------------------------------------------------------------------------
+# write-after-publish detection (ISSUE 10): the lock-free pull-snapshot
+# contract — once a center tree's buffers are handed to the pre-serialized
+# pull cache, commits must replace (never mutate) those arrays
+# ---------------------------------------------------------------------------
+
+#: id(ps) -> list[(published ndarray, fingerprint, leaf label)] for the
+#: LATEST publish per server (older payloads leave the cache when
+#: replaced); every touch under _VLOCK.  Strong references are fine: the
+#: cache's wire frames keep the arrays alive anyway, and reset() clears.
+_PUBLISHED: Dict[int, list] = {}
+
+
+def _iter_leaves(tree: Any, prefix: str = ""):
+    if isinstance(tree, dict):
+        for k in sorted(tree, key=str):
+            yield from _iter_leaves(tree[k], f"{prefix}{k}/")
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            yield from _iter_leaves(v, f"{prefix}{i}/")
+    elif isinstance(tree, np.ndarray):
+        yield prefix[:-1] if prefix else "", tree
+
+
+def _fingerprint(arr: np.ndarray) -> bytes:
+    return hashlib.blake2b(np.ascontiguousarray(arr).tobytes(),
+                           digest_size=8).digest()
+
+
+def _on_publish(owner: Any, center: Any) -> None:
+    """``ps.state`` publish hook: fingerprint every ndarray leaf the
+    pull cache just captured for ``owner``'s latest payload."""
+    if owner is None or center is None:
+        return
+    entry = [(arr, _fingerprint(arr), label)
+             for label, arr in _iter_leaves(center)]
+    with _VLOCK:
+        _PUBLISHED[id(owner)] = entry
+
+
+def _check_published(owner: Any) -> None:
+    """Verify the owner's published leaves still hold their published
+    bytes; a changed one is a write-after-publish violation (recorded
+    once per mutation — the stored fingerprint is refreshed so the same
+    corruption is not re-reported every commit)."""
+    with _VLOCK:
+        entry = _PUBLISHED.get(id(owner))
+    if not entry:
+        return
+    refreshed = []
+    for arr, fp, label in entry:
+        now = _fingerprint(arr)
+        if now != fp:
+            _record_violation(f"{type(owner).__name__}.center",
+                              "write_after_publish", label)
+        refreshed.append((arr, now, label))
+    with _VLOCK:
+        if _PUBLISHED.get(id(owner)) is entry:
+            _PUBLISHED[id(owner)] = refreshed
 
 
 def enabled_by_env() -> bool:
@@ -238,11 +314,27 @@ def install():
 
         setattr(servers.ParameterServer, name, rewrapped)
         originals.append((servers.ParameterServer, name, orig_m))
+    # write-after-publish (ISSUE 10): observe every pull-cache publish,
+    # and re-verify the published leaves after each commit applies — a
+    # rule that mutated a published tensor in place (instead of the
+    # replace-semantics contract) is caught on its very next commit
+    orig_commit = servers.ParameterServer.handle_commit
+
+    def checked_commit(self, *args, _orig=orig_commit, **kwargs):
+        out = _orig(self, *args, **kwargs)
+        _check_published(self)
+        return out
+
+    servers.ParameterServer.handle_commit = checked_commit
+    originals.append((servers.ParameterServer, "handle_commit", orig_commit))
+    from ..ps import state as ps_state
+    prev_hook = ps_state.set_publish_hook(_on_publish)
     servers.ParameterServer._dklint_racecheck = True
 
     def uninstall():
         for cls, name, orig in originals:
             setattr(cls, name, orig)
+        ps_state.set_publish_hook(prev_hook)
         servers.ParameterServer._dklint_racecheck = False
 
     return uninstall

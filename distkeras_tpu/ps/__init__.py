@@ -31,3 +31,11 @@ from .servers import (  # noqa: F401
     SocketParameterServer,
 )
 from .client import PSClient, WorkerEvicted  # noqa: F401
+from .shard import (  # noqa: F401
+    ConsistentCutError,
+    ShardedParameterServer,
+    ShardedPSClient,
+    ShardFleetError,
+    ShardPlan,
+    ShardPlanMismatch,
+)

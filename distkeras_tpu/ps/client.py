@@ -92,18 +92,29 @@ class PSClient:
         #: also reachable via ``DKTPU_WIRE=1`` for whole-process opt-out
         self._want_version = pinned_wire_version(wire_version)
         self.wire_version = 1
-        #: client-side center cache: (center_tree, server_update_counter)
+        #: client-side center cache: (center_tree, server_update_counter,
+        #: version_vector_or_None, plan_epoch_or_None)
         self._last_pull: Optional[tuple] = None
+        #: shard placement descriptor from the server's hello reply
+        #: (ISSUE 10) — None against a plain (un-sharded) server or on a
+        #: v1 connection (no hello is sent)
+        self.shard_info: Optional[dict] = None
         self.sock = connect(host, port)
         self._handshake()
 
     def _handshake(self) -> None:
         """Negotiate the wire format for this connection (the shared
         ``networking.client_handshake`` seam — serve clients run the same
-        exchange)."""
+        exchange).  A shard front-end's hello reply additionally carries
+        its placement descriptor (``shard``: index / num_shards / plan
+        epoch / plan digest — ISSUE 10), captured here so the sharded
+        client can verify agreement at negotiation time; plain servers
+        leave it None."""
+        info: dict = {}
         self.wire_version = client_handshake(
             self.sock, registry=self.registry, worker_id=self.worker_id,
-            want=self._want_version)
+            want=self._want_version, info=info)
+        self.shard_info = info.get("shard")
 
     def reconnect(self, attempts: int = 6, base_delay: float = 0.1,
                   max_delay: float = 2.0) -> None:
@@ -188,77 +199,141 @@ class PSClient:
         """Returns ``(center_tree, server_update_counter)``.  Carries the
         counter of the center already held so an idle server answers
         ``unchanged`` instead of re-shipping megabytes (ISSUE 4)."""
-        with self._span("ps.pull"):
-            def pull_msg(have=None) -> dict:
-                # one assembly point so protocol keys (like the trace
-                # header) can never be added to one request shape and
-                # missed on the other
-                msg = {"action": "pull", "worker_id": self.worker_id}
-                trace = self._trace_header()
-                if trace is not None:
-                    msg["trace"] = trace
-                if have is not None:
-                    msg["have"] = have
-                return msg
+        center, updates, _, _ = self.pull_versioned()
+        return center, updates
 
-            have = self._last_pull[1] if self._last_pull is not None \
-                else None
-            resp = self._rpc(pull_msg(have), retry=True)
+    # -- split-phase protocol (ISSUE 10) ------------------------------------
+    # The request/reply halves of pull and commit as separate calls, so a
+    # sharded client PIPELINES a fan-out on one thread: send every
+    # shard's request first (each shard starts decoding/applying while
+    # the later sends are still in flight), then collect the replies.  A
+    # thread-per-shard fan-out pays GIL contention and pool dispatch per
+    # RPC; the pipeline pays one pass of sends and one of receives.
+
+    def _pull_msg(self, have=None, min_updates=None) -> dict:
+        # one assembly point so protocol keys (like the trace header)
+        # can never be added to one request shape and missed on another
+        msg = {"action": "pull", "worker_id": self.worker_id}
+        trace = self._trace_header()
+        if trace is not None:
+            msg["trace"] = trace
+        if have is not None:
+            msg["have"] = have
+        if min_updates is not None:
+            msg["min_updates"] = int(min_updates)
+        return msg
+
+    def pull_send(self, min_updates: Optional[int] = None) -> None:
+        """Phase 1 of a pull: the request goes out (with the cached
+        counter as ``have``); :meth:`pull_finish` must be the next call
+        on this connection.  ``min_updates`` asks the server to briefly
+        wait until its counter reaches that value before serving — the
+        consistent-cut retry hint (old servers ignore it)."""
+        self._t_pull = time.perf_counter()
+        have = self._last_pull[1] if self._last_pull is not None else None
+        send_msg(self.sock, self._pull_msg(have, min_updates),
+                 registry=self.registry, version=self.wire_version)
+
+    def pull_finish(self) -> tuple:
+        """Phase 2 of a pull: ``(center, updates, version_vector,
+        plan_epoch)``.  Against a shard front-end the reply carries the
+        shard's per-worker commit counts (the version vector a
+        consistent-cut pull compares across shards) and its plan epoch;
+        plain servers leave both None.  An ``unchanged`` answer reuses
+        the cached center/vv/epoch — they can only change when the
+        counter does."""
+        resp = recv_msg(self.sock, registry=self.registry)
+        self._h_rtt.observe(time.perf_counter() - self._t_pull)
+        self._raise_on_error("pull", resp)
+        updates = int(resp["updates"])
+        if resp.get("unchanged"):
+            if self._last_pull is not None:
+                self._c_unchanged.inc()
+                return (self._last_pull[0], updates,
+                        self._last_pull[2], self._last_pull[3])
+            # the cache was invalidated mid-exchange (a reconnect dropped
+            # it, but a stale ``have`` was resent): ask again
+            # unconditionally for the full center
+            resp = self._rpc(self._pull_msg())
             self._raise_on_error("pull", resp)
             updates = int(resp["updates"])
-            if resp.get("unchanged"):
-                if self._last_pull is not None:
-                    self._c_unchanged.inc()
-                    return self._last_pull[0], updates
-                # the cache was invalidated mid-RPC (a transparent
-                # reconnect dropped it, but the retry resent the stale
-                # ``have``): ask again unconditionally for the full center
-                resp = self._rpc(pull_msg(), retry=True)
-                self._raise_on_error("pull", resp)
-                updates = int(resp["updates"])
-            self._last_pull = (resp["center"], updates)
-            return resp["center"], updates
+        vv = resp.get("vv")
+        if isinstance(vv, dict):
+            vv = {int(k): int(v) for k, v in vv.items()}
+        epoch = resp.get("plan_epoch")
+        self._last_pull = (resp["center"], updates, vv, epoch)
+        return resp["center"], updates, vv, epoch
+
+    def pull_versioned(self) -> tuple:
+        """The full pull protocol in one call (transparently reconnects
+        and retries once on a dead connection — an idempotent read)."""
+        with self._span("ps.pull"):
+            try:
+                self.pull_send()
+                return self.pull_finish()
+            except (ConnectionError, OSError):
+                self.reconnect()
+                self.pull_send()
+                return self.pull_finish()
+
+    def commit_send(self, delta: Any, last_update: Optional[int] = None,
+                    gap_s: Optional[float] = None) -> None:
+        """Phase 1 of a commit: codec-encode and ship the delta;
+        :meth:`commit_finish` must be the next call on this
+        connection."""
+        if not self.codec.is_identity:
+            t0 = time.perf_counter()
+            raw = codecs.tree_payload_bytes(delta)
+            delta = self.codec.encode(delta)
+            codecs.count_codec_bytes(self.registry, raw,
+                                     codecs.tree_payload_bytes(delta))
+            self._h_encode.observe(time.perf_counter() - t0)
+        msg = {"action": "commit", "worker_id": self.worker_id,
+               "gen": self.generation,
+               "delta": delta, "codec": self.codec.name}
+        trace = self._trace_header()
+        if trace is not None:
+            msg["trace"] = trace
+        if gap_s is not None:
+            msg["gap_s"] = float(gap_s)
+        if last_update is not None:
+            msg["last_update"] = int(last_update)
+        self._t_commit = time.perf_counter()
+        send_msg(self.sock, msg, registry=self.registry,
+                 version=self.wire_version)
+
+    def commit_finish(self) -> bool:
+        """Phase 2 of a commit: True when applied, False when a fault
+        injector dropped it; an eviction notice raises
+        :class:`WorkerEvicted`."""
+        resp = recv_msg(self.sock, registry=self.registry)
+        self._h_rtt.observe(time.perf_counter() - self._t_commit)
+        # a server-side apply failure answers {"ok": False, "error"}
+        # (it did NOT apply the delta) — that must surface as a
+        # failure to the worker's retry policy, never as success
+        self._raise_on_error("commit", resp)
+        if resp.get("evicted"):
+            # the PS tombstoned this commit: a newer incarnation owns
+            # the worker id — this one's loop must wind down (ISSUE 9)
+            raise WorkerEvicted(
+                f"worker {self.worker_id} generation "
+                f"{self.generation} evicted by the PS")
+        return not resp.get("dropped", False)
 
     def commit(self, delta: Any, last_update: Optional[int] = None,
                gap_s: Optional[float] = None) -> bool:
         """Commit a delta; returns False if a fault injector dropped it.
         A non-identity codec compresses the payload here (error-feedback
         residual updated as a side effect) — the server decodes
-        statelessly from the per-leaf stubs.
+        statelessly from the per-leaf stubs.  Never auto-retries (the
+        server may have applied the delta before a connection died).
 
         ``gap_s`` is the worker's monotonic gap since its previous window
         commit — the heartbeat signal the server-side straggler detector
         folds in (ISSUE 5); harmless extra key to old servers."""
         with self._span("ps.commit"):
-            if not self.codec.is_identity:
-                t0 = time.perf_counter()
-                raw = codecs.tree_payload_bytes(delta)
-                delta = self.codec.encode(delta)
-                codecs.count_codec_bytes(self.registry, raw,
-                                         codecs.tree_payload_bytes(delta))
-                self._h_encode.observe(time.perf_counter() - t0)
-            msg = {"action": "commit", "worker_id": self.worker_id,
-                   "gen": self.generation,
-                   "delta": delta, "codec": self.codec.name}
-            trace = self._trace_header()
-            if trace is not None:
-                msg["trace"] = trace
-            if gap_s is not None:
-                msg["gap_s"] = float(gap_s)
-            if last_update is not None:
-                msg["last_update"] = int(last_update)
-            resp = self._rpc(msg)
-            # a server-side apply failure answers {"ok": False, "error"}
-            # (it did NOT apply the delta) — that must surface as a
-            # failure to the worker's retry policy, never as success
-            self._raise_on_error("commit", resp)
-            if resp.get("evicted"):
-                # the PS tombstoned this commit: a newer incarnation owns
-                # the worker id — this one's loop must wind down (ISSUE 9)
-                raise WorkerEvicted(
-                    f"worker {self.worker_id} generation "
-                    f"{self.generation} evicted by the PS")
-            return not resp.get("dropped", False)
+            self.commit_send(delta, last_update=last_update, gap_s=gap_s)
+            return self.commit_finish()
 
     def stats(self) -> dict:
         """Poll the server's live telemetry: ``{"stats": <registry

@@ -189,12 +189,18 @@ def choose_wire_version(offered: Optional[Sequence[int]],
 
 def client_handshake(sock: socket.socket, registry=None,
                      worker_id: Optional[int] = None,
-                     want: Optional[int] = None) -> int:
+                     want: Optional[int] = None,
+                     info: Optional[dict] = None) -> int:
     """Client side of the hello handshake; returns the negotiated wire
     version for this connection.  The hello itself is always v1-framed
     (any server parses it); current servers answer with the agreed
     version, old ones with an unknown-action error — that failure IS the
-    negotiation result: v1."""
+    negotiation result: v1.
+
+    ``info``, when given, is updated in place with the server's full
+    hello reply — the channel for negotiation-time extras like a shard
+    front-end's placement descriptor (ISSUE 10); old servers' replies
+    simply carry no extra keys."""
     want = pinned_wire_version(want)
     want = WIRE_VERSION if want is None else int(want)
     if want < 2:
@@ -205,6 +211,8 @@ def client_handshake(sock: socket.socket, registry=None,
         msg["worker_id"] = int(worker_id)
     send_msg(sock, msg, registry=registry)
     resp = recv_msg(sock, registry=registry)
+    if info is not None and isinstance(resp, dict):
+        info.update(resp)
     if resp.get("ok"):
         return int(resp.get("version", 1))
     return 1
@@ -403,6 +411,14 @@ class FrameServer:
     def _on_start(self) -> None:
         """After the listener is bound, before the accept thread spawns."""
 
+    def hello_reply(self, msg: dict, ver: int) -> dict:
+        """The ``hello`` reply document.  Subclasses append
+        negotiation-time extras (a shard front-end ships its placement
+        descriptor here — ISSUE 10); unknown keys are ignored by every
+        parser of this wire, so extras degrade cleanly against old
+        clients."""
+        return {"ok": True, "version": ver}
+
     def _before_close_connections(self) -> None:
         """Between closing the listener and closing live connections —
         where in-flight work drains so replies still flush."""
@@ -512,7 +528,7 @@ class FrameServer:
                                                   self.max_wire_version)
                         # the reply itself stays v1-framed: the client
                         # switches only after reading it
-                        send_msg(conn, {"ok": True, "version": ver},
+                        send_msg(conn, self.hello_reply(msg, ver),
                                  registry=reg)
                     elif action == "stop":
                         send_msg(conn, {"ok": True}, registry=reg,

@@ -35,6 +35,7 @@ from ..obs.spans import SpanTracer
 from ..parallel.sync import make_window_fn
 from ..utils import serde
 from .servers import SocketParameterServer
+from .shard import ShardedParameterServer
 from .workers import ElasticWorker, PullCommitWorker, StalenessWorker
 
 _WORKER_CLASSES = {
@@ -150,9 +151,16 @@ class FleetSupervisor:
     def __init__(self, ps, server, spawn, *, heartbeat_hard_s: float = 30.0,
                  startup_grace_s: float = 300.0, poll_s: float = 0.05,
                  max_attempts: int = 2, timeout: Optional[float] = None,
-                 metrics=None, placement: str = "threads"):
+                 metrics=None, placement: str = "threads",
+                 shard_watch=None):
         self.ps = ps
         self.server = server
+        #: sharded-center health probe (ISSUE 10): called once per poll;
+        #: raises ``ShardFleetError`` naming the dead shard (id, address,
+        #: last commit counter) so the run fails loudly and immediately
+        #: instead of workers spinning in reconnect backoff against a
+        #: vanished listener.  None for the single-server star.
+        self.shard_watch = shard_watch
         #: spawn(worker_id, start_window, generation, attempt) -> handle;
         #: the placement-specific closure (thread worker / worker process)
         self.spawn = spawn
@@ -282,6 +290,12 @@ class FleetSupervisor:
         deadline = None if self.timeout is None \
             else time.monotonic() + float(self.timeout)
         while True:
+            if self.shard_watch is not None:
+                # a dead center shard is fatal for every worker at once:
+                # surface it HERE, with its name, not as N workers timing
+                # out in reconnect backoff (ISSUE 10 satellite; failover
+                # is the ROADMAP's self-healing round-3 item)
+                self.shard_watch()
             with self._lock:
                 live = dict(self.live)
             if not live:
@@ -399,33 +413,54 @@ def run_async_training(trainer, dataset, fault_injector=None,
 
     center = jax.tree_util.tree_map(np.asarray,
                                     trainer.model.init(trainer.seed))
+    ps_shards = int(getattr(trainer, "ps_shards", 1))
     ps_kwargs = {}
     ckpt = trainer._ckpt_manager()
-    if ckpt is not None:
+    if ckpt is not None and ps_shards == 1:
         # checkpoint the center roughly once per worker round of commits
         ps_kwargs = {"checkpoint_manager": ckpt,
                      "checkpoint_every": trainer.num_workers}
-    ps = trainer._ps_factory()(center, num_workers=trainer.num_workers,
-                               **ps_kwargs)
     num_epoch = trainer.num_epoch
     start_windows = [0] * trainer.num_workers
-    if ckpt is not None and getattr(trainer, "_resume", False):
-        if ps.restore(ckpt):
-            # EXACT resume: one commit per communication window, so the
-            # snapshot's per-worker commit count IS the global window index
-            # each worker continues from — mid-epoch included (SURVEY.md
-            # §5.4).  No epoch approximation from the global counter.
-            start_windows = [ps.commits_by_worker.get(k, 0)
-                             for k in range(trainer.num_workers)]
-            center = ps.get_model()  # workers start from the restored center
-    # server-side tracer shares the trainer's JSONL sink: every commit's
-    # ``ps.apply`` span adopts the committing worker's trace context, so
-    # the stream links server applies to the worker windows that caused
-    # them (obsview's cross-process timeline, ISSUE 5); span durations
-    # also land in the PS registry (``span.ps.apply.seconds``)
-    server = SocketParameterServer(
-        ps, fault_injector=fault_injector,
-        tracer=SpanTracer(trainer.metrics, registry=ps.registry)).start()
+    if ps_shards > 1:
+        if ckpt is not None:
+            get_logger("ps.shard").warning(
+                "sharded PS (%d shards) does not checkpoint/restore the "
+                "center yet (deferred with shard failover to the "
+                "ROADMAP's self-healing round 3); this run is "
+                "checkpoint-free", ps_shards)
+        # one update-rule server + front-end PER SHARD, each with its own
+        # lock, accept loop, pull cache, codec accounting and registry;
+        # every shard's tracer shares the trainer's JSONL sink so apply
+        # spans still link to the worker windows that caused them
+        ps = ShardedParameterServer(
+            center, ps_shards, trainer._ps_factory(),
+            num_workers=trainer.num_workers, fault_injector=fault_injector,
+            tracer_factory=lambda reg: SpanTracer(trainer.metrics,
+                                                  registry=reg))
+        server = ps.start()
+    else:
+        ps = trainer._ps_factory()(center, num_workers=trainer.num_workers,
+                                   **ps_kwargs)
+        if ckpt is not None and getattr(trainer, "_resume", False):
+            if ps.restore(ckpt):
+                # EXACT resume: one commit per communication window, so
+                # the snapshot's per-worker commit count IS the global
+                # window index each worker continues from — mid-epoch
+                # included (SURVEY.md §5.4).  No epoch approximation from
+                # the global counter.
+                start_windows = [ps.commits_by_worker.get(k, 0)
+                                 for k in range(trainer.num_workers)]
+                center = ps.get_model()  # workers start from the restored
+        # server-side tracer shares the trainer's JSONL sink: every
+        # commit's ``ps.apply`` span adopts the committing worker's trace
+        # context, so the stream links server applies to the worker
+        # windows that caused them (obsview's cross-process timeline,
+        # ISSUE 5); span durations also land in the PS registry
+        server = SocketParameterServer(
+            ps, fault_injector=fault_injector,
+            tracer=SpanTracer(trainer.metrics,
+                              registry=ps.registry)).start()
     t_run0 = time.time()  # heartbeats at/after this instant belong to THIS run
 
     try:
@@ -484,14 +519,24 @@ def run_async_training(trainer, dataset, fault_injector=None,
 # thread placement (in-process, one device per worker)
 # ---------------------------------------------------------------------------
 
+def _endpoint(server):
+    """Worker-facing PS endpoint: the single server's port, or the shard
+    fleet's port LIST (workers then build a ``ShardedPSClient``)."""
+    ports = getattr(server, "ports", None)
+    return list(ports) if ports is not None else server.port
+
+
 def _supervisor_for(trainer, ps, server, spawn, placement: str,
                     timeout: Optional[float] = None) -> FleetSupervisor:
-    """Build the fleet supervisor from the trainer's knobs (ISSUE 9)."""
+    """Build the fleet supervisor from the trainer's knobs (ISSUE 9).
+    A sharded center additionally wires its health probe in: a dead
+    shard fails the run loudly (ISSUE 10)."""
     return FleetSupervisor(
         ps, server, spawn, placement=placement, timeout=timeout,
         heartbeat_hard_s=getattr(trainer, "heartbeat_hard_s", 30.0),
         startup_grace_s=getattr(trainer, "startup_grace_s", 300.0),
-        metrics=trainer.metrics)
+        metrics=trainer.metrics,
+        shard_watch=getattr(server, "raise_if_unhealthy", None))
 
 
 def _supervise(trainer, sup: FleetSupervisor, start_windows) -> list:
@@ -521,6 +566,7 @@ def _run_thread_workers(trainer, ps, server, mode, center, xs, ys, num_epoch,
     worker_cls = _WORKER_CLASSES[mode]
     devices = jax.devices()
     P = trainer.num_workers
+    endpoint = _endpoint(server)
 
     def spawn(k: int, start_window: int, generation: int, attempt: int):
         """One worker incarnation: initial fleet, supervisor respawn, and
@@ -537,7 +583,7 @@ def _run_thread_workers(trainer, ps, server, mode, center, xs, ys, num_epoch,
             jax.device_put(optimizer.init(fresh["params"]), dev),
             jax.device_put(jax.random.PRNGKey(
                 trainer.seed + 1 + k + 100 * attempt), dev),
-            "127.0.0.1", server.port, num_epoch, device=dev,
+            "127.0.0.1", endpoint, num_epoch, device=dev,
             start_window=start_window, metrics=trainer.metrics,
             comm_codec=getattr(trainer, "comm_codec", "none"),
             profile_memory=trainer.profile.memory,
@@ -635,7 +681,7 @@ def _run_process_workers(trainer, ps, server, mode, center, xs, ys,
             "comm_codec": getattr(trainer, "comm_codec", "none"),
             "profile_memory": bool(trainer.profile.memory),
             "alpha": float(getattr(trainer, "alpha", 0.0)),
-            "worker_id": k, "host": "127.0.0.1", "port": server.port,
+            "worker_id": k, "host": "127.0.0.1", "port": _endpoint(server),
             "num_epoch": num_epoch, "seed": seed,
             "start_window": int(start_window),
             "gen": int(generation),

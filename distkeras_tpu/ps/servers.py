@@ -35,9 +35,8 @@ from ..obs import COUNT_BUCKETS, TIME_BUCKETS, Registry, StragglerDetector
 from ..obs.spans import SpanTracer
 from ..parallel.sync import _inexact, tmap as _tree_map
 from ..utils import native
-from . import codecs
-from .networking import (REPLY_SENT, WIRE_VERSION, FrameServer, pack_msg,
-                         send_packed)
+from .networking import (REPLY_SENT, WIRE_VERSION, FrameServer, send_packed)
+from .state import DeltaDecoder, LivenessTable, PullCache
 
 Tree = Any
 
@@ -108,6 +107,11 @@ class ParameterServer:
         self._c_joins = self.registry.counter("ps.joins")
         self._h_apply = self.registry.histogram("ps.apply_seconds",
                                                 TIME_BUCKETS)
+        #: time commits spend WAITING for the mutex (ISSUE 10): the
+        #: single-lock convoy the contention sweep measures, directly —
+        #: ``ps.apply_seconds`` is the hold time, this is the queue
+        self._h_lock_wait = self.registry.histogram(
+            "ps.lock_wait_seconds", TIME_BUCKETS)
 
     # -- update rule (subclass responsibility) ------------------------------
     def apply_commit(self, delta: Tree, meta: dict) -> None:  # dklint: holds=mutex
@@ -131,6 +135,7 @@ class ParameterServer:
         snapshot = None
         t0 = time.perf_counter()
         with self.mutex:
+            self._h_lock_wait.observe(time.perf_counter() - t0)
             w = meta.get("worker_id")
             if w is not None:
                 w = int(w)
@@ -224,6 +229,17 @@ class ParameterServer:
         self._c_pulls.inc()
         with self.mutex:
             return self.center, self.num_updates
+
+    def pull_versioned(self) -> tuple:
+        """``(center, num_updates, commits_by_worker)`` captured under ONE
+        mutex hold — the shard front-end's pull source (ISSUE 10): the
+        per-worker commit counts are the **version vector** a sharded
+        client compares across shards to detect a torn cut, so they must
+        be atomic with the center they describe."""
+        self._c_pulls.inc()
+        with self.mutex:
+            return (self.center, self.num_updates,
+                    {int(k): int(v) for k, v in self.commits_by_worker.items()})
 
     def stats(self) -> dict:
         """Registry snapshot + ground-truth counters — the payload the
@@ -366,43 +382,17 @@ class SocketParameterServer(FrameServer):
         #: PS registry so the live ``stats`` RPC carries it
         self.stragglers = straggler_detector if straggler_detector \
             is not None else StragglerDetector(registry=ps.registry)
-        #: pre-serialized pull replies: wire version -> (num_updates,
-        #: pack_msg payload); every touch goes through _cache_lock
-        self._pull_cache: dict = {}
-        self._cache_lock = threading.Lock()
-        #: per-worker liveness (ISSUE 9): worker -> monotonic stamp of its
-        #: last commit/pull, and the last commit-weight gauge value set —
-        #: both written by handler threads, every touch under _seen_lock
-        self._last_seen: dict = {}
-        self._weights: dict = {}
-        self._seen_lock = threading.Lock()
+        #: composable center-state layer (ISSUE 10 — the state half of the
+        #: PR 8 FrameServer extraction): pre-serialized pull cache,
+        #: per-worker liveness stamps, codec decode — each a standalone
+        #: component so a shard fleet hosts one SET per shard instead of
+        #: N copies of this class's internals
+        self._pull_cache = PullCache(ps.registry)
+        self._liveness = LivenessTable()
+        self._decode_delta = DeltaDecoder(ps.registry)
         self._c_requests = ps.registry.counter("ps.commit_requests")
         self._c_dropped = ps.registry.counter("ps.commits_dropped")
         self._c_unchanged = ps.registry.counter("ps.pulls_unchanged")
-        self._c_cache_hits = ps.registry.counter("ps.pull_cache_hits")
-        self._h_decode = ps.registry.histogram("ps.codec.decode_seconds",
-                                               TIME_BUCKETS)
-
-    def _center_payload(self, center, updates: int, ver: int):
-        """Pre-serialized pull reply for this (counter, wire version) —
-        built once per commit, served to every puller.  The payload is
-        encoded OUTSIDE the cache lock so a slow big-model serialization
-        never serializes concurrent pulls of an already-cached center."""
-        with self._cache_lock:
-            ent = self._pull_cache.get(ver)
-            if ent is not None and ent[0] == updates:
-                self._c_cache_hits.inc()
-                return ent[1]
-        payload = pack_msg({"center": center, "updates": updates},
-                           version=ver)
-        with self._cache_lock:
-            cur = self._pull_cache.get(ver)
-            # never regress: a racing handler may have cached a NEWER
-            # center; replacing it with this older snapshot would hand a
-            # committed worker a pre-commit center on its next pull
-            if cur is None or updates >= cur[0]:
-                self._pull_cache[ver] = (updates, payload)
-        return payload
 
     def _remote_span(self, name: str, msg: dict):
         """Server-side span adopting the requester's trace context (the
@@ -423,36 +413,10 @@ class SocketParameterServer(FrameServer):
             fields["parent_span"] = trace["parent_span"]
         return self.tracer.span(name, **fields)
 
-    def _decoded_delta(self, msg: dict):
-        """Commit delta, codec stubs decoded (latency + bytes observed)."""
-        delta = msg.get("delta")
-        if msg.get("codec") in (None, "none"):
-            return delta
-        reg = self.ps.registry
-        t0 = time.perf_counter()
-        enc_bytes = codecs.tree_payload_bytes(delta)
-        delta = codecs.decode_tree(delta)
-        codecs.count_codec_bytes(reg, codecs.tree_payload_bytes(delta),
-                                 enc_bytes)
-        self._h_decode.observe(time.perf_counter() - t0)
-        return delta
-
-    def _touch(self, worker_id) -> None:
-        """Refresh this worker's liveness stamp (commit AND pull traffic
-        both count: a worker blocked in compute still pulled recently;
-        one truly wedged — SIGSTOP, dead socket — goes silent on both)."""
-        if worker_id is None:
-            return
-        now = time.monotonic()
-        with self._seen_lock:
-            self._last_seen[int(worker_id)] = now
-
     def last_seen_age(self, worker_id) -> Optional[float]:
         """Seconds since this worker's last commit/pull; None if it never
         reached the server — the supervisor's liveness source."""
-        with self._seen_lock:
-            t = self._last_seen.get(int(worker_id))
-        return None if t is None else time.monotonic() - t
+        return self._liveness.age(worker_id)
 
     def _commit_weight(self, worker_id) -> float:
         """Down-weighting multiplier for this commit (ISSUE 9 rung 1),
@@ -462,12 +426,18 @@ class SocketParameterServer(FrameServer):
             return 1.0
         w = int(worker_id)
         weight = self.stragglers.commit_weight(w)
-        with self._seen_lock:
-            changed = self._weights.get(w) != weight
-            self._weights[w] = weight
-        if changed:
+        if self._liveness.weight_changed(w, weight):
             self.ps.registry.gauge(f"ps.commit_weight.worker{w}").set(weight)
         return weight
+
+    # -- pull state seam (ISSUE 10) -----------------------------------------
+    def _pull_state(self) -> tuple:
+        """``(center, updates, extra_reply_fields)`` for one pull.  The
+        shard front-end overrides this to add its version vector and plan
+        epoch — the consistent-cut pull's raw material — without
+        re-implementing the cache/unchanged protocol."""
+        center, updates = self.ps.pull()
+        return center, updates, {}
 
     def handle_request(self, action, msg: dict, ver: int,
                        conn: socket.socket):
@@ -475,20 +445,35 @@ class SocketParameterServer(FrameServer):
         errors live in ``FrameServer``)."""
         if action == "pull":
             with self._remote_span("ps.serve_pull", msg):
-                self._touch(msg.get("worker_id"))
+                self._liveness.touch(msg.get("worker_id"))
                 have = msg.get("have")
-                center, updates = self.ps.pull()
+                want = msg.get("min_updates")
+                if want is not None:
+                    # consistent-cut retry hint (ISSUE 10): the puller
+                    # already knows the fleet has reached ``want``
+                    # updates, so briefly wait for the in-flight applies
+                    # to land HERE rather than shipping a slice the
+                    # client will discard as torn and re-request
+                    deadline = time.perf_counter() + 0.05
+                    while (self.ps.num_updates < int(want)
+                           and self._running.is_set()
+                           and time.perf_counter() < deadline):
+                        time.sleep(0.0005)
+                center, updates, extra = self._pull_state()
                 if have is not None and int(have) == updates:
                     self._c_unchanged.inc()
-                    return {"unchanged": True, "updates": updates}
-                send_packed(conn, self._center_payload(center, updates, ver),
-                            registry=self.ps.registry)
+                    return {"unchanged": True, "updates": updates, **extra}
+                payload = self._pull_cache.payload(
+                    ver, updates,
+                    lambda: {"center": center, "updates": updates, **extra},
+                    owner=self.ps)
+                send_packed(conn, payload, registry=self.ps.registry)
                 return REPLY_SENT
         if action == "commit":
             # every commit REQUEST counts before any outcome branches, so
             # requests == applied + dropped + tombstoned always holds
             self._c_requests.inc()
-            self._touch(msg.get("worker_id"))
+            self._liveness.touch(msg.get("worker_id"))
             # liveness first: a dropped commit is still a heartbeat — the
             # fault injector models a lost UPDATE, not a dead worker
             if msg.get("gap_s") is not None:
@@ -501,7 +486,7 @@ class SocketParameterServer(FrameServer):
                 weight = self._commit_weight(msg.get("worker_id"))
                 if weight != 1.0:
                     msg["commit_weight"] = weight
-                delta = self._decoded_delta(msg)
+                delta = self._decode_delta(msg)
                 with self._remote_span("ps.apply", msg):
                     applied = self.ps.handle_commit(delta, msg)
             else:
@@ -516,10 +501,7 @@ class SocketParameterServer(FrameServer):
         if action == "stats":
             reply = self.ps.stats()
             reply["stragglers"] = self.stragglers.snapshot()
-            now = time.monotonic()
-            with self._seen_lock:
-                seen = dict(self._last_seen)
-            reply.setdefault("fleet", {})["last_seen_age_s"] = {
-                w: now - t for w, t in seen.items()}
+            reply.setdefault("fleet", {})["last_seen_age_s"] = \
+                self._liveness.ages()
             return reply
         return None

@@ -1,0 +1,32 @@
+"""Sharded parameter server (ISSUE 10): partition the center pytree
+across a fleet of single-shard servers — per-shard locks, accept loops,
+pull caches, codec state, and obs registries — with **consistent-cut
+pulls** so a worker never trains on a half-applied center.
+
+The star topology's measured ceiling (one ``apply_commit`` lock, one
+accept thread — the w4 contention sweep's 6× commit-RTT pileup) becomes
+one ceiling per shard; this is the DistBelief/DOWNPOUR star→fleet step
+(Dean et al., NIPS'12) in the Li et al. (OSDI'14) sharded-server shape.
+
+* :class:`ShardPlan` — deterministic per-tensor placement, digest-checked
+  between workers and shards in the ``hello`` negotiation.
+* :class:`ShardedParameterServer` — hosts N shards; supervisor-facing
+  facade (evict/respawn/join fan out; a dead shard is a named fatal
+  error, failover deferred to the ROADMAP's self-healing round 3).
+* :class:`ShardedPSClient` — the ``PSClient`` surface over parallel
+  fan-out; pulls retry lagging shards until the per-worker commit-count
+  version vectors agree across the fleet.
+"""
+
+from .plan import ShardPlan  # noqa: F401
+from .server import (  # noqa: F401
+    ShardedParameterServer,
+    ShardFleetError,
+    ShardFrontend,
+)
+from .client import (  # noqa: F401
+    ConsistentCutError,
+    ShardedPSClient,
+    ShardPlanMismatch,
+    merge_fleet_stats,
+)

@@ -1,0 +1,161 @@
+"""Composable center-state components for PS front-ends (ISSUE 10).
+
+PR 8's ``FrameServer`` extraction gave both TCP services one front-end
+frame; this module is the matching **state half**: the pieces
+``SocketParameterServer`` used to carry inline — the pre-serialized pull
+cache, the per-worker liveness table, and the codec decode path — as
+standalone classes, so a fleet of shard front-ends composes N of each
+(one per shard, each with its own lock and registry) instead of
+N copies of a 500-line server multiplying every concern.
+
+* :class:`PullCache` — pre-serialized pull replies keyed by wire version,
+  built once per commit and served to every puller, with the
+  never-regress rule (a racing handler must not replace a newer center
+  with an older snapshot).  The cache is the **publish point** of the
+  lock-free pull-snapshot contract: once a center tree's buffers are
+  handed to a cached v2 frame, commits must replace — never mutate —
+  those arrays.  :func:`set_publish_hook` lets dklint's runtime
+  racecheck observe every publish and flag write-after-publish
+  violations (ISSUE 10 satellite).
+* :class:`LivenessTable` — monotonic last-seen stamps per worker (commit
+  AND pull traffic both count) plus the last commit-weight gauge value,
+  the supervisor's liveness source.
+* :class:`DeltaDecoder` — stateless ``ps.codecs`` decode with the
+  latency/byte accounting, per front-end so a shard's codec traffic is
+  its own.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ..obs import TIME_BUCKETS
+from . import codecs
+from .networking import pack_msg
+
+# ---------------------------------------------------------------------------
+# publish-hook seam (dklint racecheck's write-after-publish detector)
+# ---------------------------------------------------------------------------
+
+#: called as ``hook(owner, center_tree)`` every time a center tree's
+#: buffers are handed to the pull cache (``owner`` identifies the
+#: ParameterServer whose state was published).  None (the default) costs
+#: one global read per cache build.
+_publish_hook: Optional[Callable[[Any, Any], None]] = None
+
+
+def set_publish_hook(hook):
+    """Install (or clear, with None) the pull-cache publish observer;
+    returns the previous hook so racecheck can nest/restore."""
+    global _publish_hook
+    prev = _publish_hook
+    _publish_hook = hook
+    return prev
+
+
+class PullCache:
+    """Pre-serialized pull replies: wire version -> ``(updates, payload)``.
+
+    The payload is encoded OUTSIDE the cache lock so a slow big-model
+    serialization never serializes concurrent pulls of an already-cached
+    center; the never-regress rule keeps a racing handler from replacing
+    a NEWER cached center with an older snapshot (which would hand a
+    committed worker a pre-commit center on its next pull).
+    """
+
+    def __init__(self, registry, prefix: str = "ps"):
+        self._cache: dict = {}
+        self._lock = threading.Lock()
+        self._c_hits = registry.counter(f"{prefix}.pull_cache_hits")
+
+    def payload(self, ver: int, updates: int, doc_builder: Callable[[], dict],
+                owner: Any = None):
+        """The cached ``pack_msg`` payload for this (counter, wire
+        version), building (and publishing) it on miss.  ``doc_builder``
+        returns the reply document — called only when the cache misses,
+        so versioned extras (a shard's version vector) are captured
+        exactly once per counter."""
+        with self._lock:
+            ent = self._cache.get(ver)
+            if ent is not None and ent[0] == updates:
+                self._c_hits.inc()
+                return ent[1]
+        doc = doc_builder()
+        payload = pack_msg(doc, version=ver)
+        hook = _publish_hook
+        if hook is not None:
+            # the doc's center arrays are now referenced by wire buffers:
+            # this is the publish instant the racecheck contract guards
+            hook(owner, doc.get("center"))
+        with self._lock:
+            cur = self._cache.get(ver)
+            if cur is None or updates >= cur[0]:
+                self._cache[ver] = (updates, payload)
+        return payload
+
+
+class LivenessTable:
+    """Per-worker liveness stamps + commit-weight memo, every touch under
+    one lock (written by handler threads, read by the supervisor)."""
+
+    def __init__(self):
+        self._last_seen: dict = {}
+        self._weights: dict = {}
+        self._lock = threading.Lock()
+
+    def touch(self, worker_id) -> None:
+        """Refresh this worker's liveness stamp (commit AND pull traffic
+        both count: a worker blocked in compute still pulled recently;
+        one truly wedged — SIGSTOP, dead socket — goes silent on both)."""
+        if worker_id is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            self._last_seen[int(worker_id)] = now
+
+    def age(self, worker_id) -> Optional[float]:
+        """Seconds since this worker's last commit/pull; None if it never
+        reached the server — the supervisor's liveness source."""
+        with self._lock:
+            t = self._last_seen.get(int(worker_id))
+        return None if t is None else time.monotonic() - t
+
+    def ages(self) -> dict:
+        """{worker: seconds since last seen} — the ``stats`` reply's
+        fleet-liveness section."""
+        now = time.monotonic()
+        with self._lock:
+            seen = dict(self._last_seen)
+        return {w: now - t for w, t in seen.items()}
+
+    def weight_changed(self, worker_id: int, weight: float) -> bool:
+        """Record the latest commit weight; True when it differs from the
+        last one seen (the gauge-update edge)."""
+        with self._lock:
+            changed = self._weights.get(worker_id) != weight
+            self._weights[worker_id] = weight
+        return changed
+
+
+class DeltaDecoder:
+    """Stateless commit-delta decode (``ps.codecs`` stubs) with the
+    latency + byte accounting in the owning front-end's registry."""
+
+    def __init__(self, registry):
+        self.registry = registry
+        self._h_decode = registry.histogram("ps.codec.decode_seconds",
+                                            TIME_BUCKETS)
+
+    def __call__(self, msg: dict):
+        delta = msg.get("delta")
+        if msg.get("codec") in (None, "none"):
+            return delta
+        t0 = time.perf_counter()
+        enc_bytes = codecs.tree_payload_bytes(delta)
+        delta = codecs.decode_tree(delta)
+        codecs.count_codec_bytes(self.registry,
+                                 codecs.tree_payload_bytes(delta), enc_bytes)
+        self._h_decode.observe(time.perf_counter() - t0)
+        return delta

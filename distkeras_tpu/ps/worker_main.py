@@ -80,11 +80,16 @@ def run_spec(spec_path: str) -> None:
     if spec.get("metrics_jsonl"):
         from ..utils.metrics import MetricsLogger
         metrics = MetricsLogger(spec["metrics_jsonl"])
+    # a LIST of ports is a shard fleet (ISSUE 10): the worker builds a
+    # ShardedPSClient and fans its windows across every shard
+    port = spec["port"]
+    port = [int(p) for p in port] if isinstance(port, (list, tuple)) \
+        else int(port)
     worker = worker_cls(
         int(spec["worker_id"]), window_fn, center,
         optimizer.init(center["params"]),
         jax.random.PRNGKey(int(spec["seed"])),
-        spec["host"], int(spec["port"]), int(spec["num_epoch"]),
+        spec["host"], port, int(spec["num_epoch"]),
         start_window=int(spec.get("start_window", 0)),
         comm_codec=spec.get("comm_codec", "none"), metrics=metrics,
         profile_memory=bool(spec.get("profile_memory", True)),

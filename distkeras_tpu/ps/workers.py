@@ -121,6 +121,22 @@ class AsyncWorker(threading.Thread):
             return _tmap(lambda x: jax.device_put(x, self.device), tree)
         return tree
 
+    def _make_client(self):
+        """One PS connection — or, when ``port`` is a LIST of shard
+        ports (ISSUE 10), a ``ShardedPSClient`` fanning this worker's
+        traffic across the fleet with consistent-cut pulls.  Either way
+        the worker loop drives the same pull/commit surface."""
+        if isinstance(self.ps_port, (list, tuple)):
+            from .shard import ShardedPSClient
+            return ShardedPSClient(
+                [(self.ps_host, p) for p in self.ps_port],
+                template=_host(self.variables), worker_id=self.worker_id,
+                codec=self.comm_codec, tracer=self.tracer,
+                generation=self.generation)
+        return PSClient(self.ps_host, self.ps_port, self.worker_id,
+                        codec=self.comm_codec, tracer=self.tracer,
+                        generation=self.generation)
+
     def run(self):
         try:
             # built HERE so the thread-local trace id binds to the worker's
@@ -128,9 +144,7 @@ class AsyncWorker(threading.Thread):
             self.tracer = SpanTracer(self.metrics)
             self.tracer.set_trace_id(f"w{self.worker_id}")
             self._last_commit_mono = time.monotonic()
-            client = PSClient(self.ps_host, self.ps_port, self.worker_id,
-                              codec=self.comm_codec, tracer=self.tracer,
-                              generation=self.generation)
+            client = self._make_client()
             try:
                 self._train(client)
             finally:

@@ -483,6 +483,7 @@ class DistributedTrainer(Trainer):
                  mode: str = "sync", mesh=None,
                  async_workers: str = "threads",
                  comm_codec: str = "none",
+                 ps_shards: int = 1,
                  heartbeat_hard_s: float = 30.0,
                  startup_grace_s: float = 300.0, **kw):
         super().__init__(keras_model, worker_optimizer, loss, features_col,
@@ -514,6 +515,15 @@ class DistributedTrainer(Trainer):
         #: or one OS process per worker — the reference's deployment shape
         #: (Spark executor tasks); see ``ps.runner`` / ``ps.worker_main``.
         self.async_workers = async_workers
+        #: async-mode center sharding (ISSUE 10): 1 (default) hosts the
+        #: center on one SocketParameterServer — bit-identical to the
+        #: pre-shard behavior; N > 1 partitions the center pytree across
+        #: N shard servers (``ps.shard``), each with its own lock/accept
+        #: loop/pull cache, and workers fan commits/pulls out in parallel
+        #: with consistent-cut assembly.
+        self.ps_shards = int(ps_shards)
+        if self.ps_shards < 1:
+            raise ValueError(f"ps_shards must be >= 1, got {ps_shards}")
         #: async-mode commit compression (``ps.codecs``): "none" (default,
         #: bit-identical numerics), "int8", "bf16", or "topk<frac>" —
         #: quantized deltas with worker-side error feedback (ISSUE 4).

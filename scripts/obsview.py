@@ -489,6 +489,69 @@ def poll_stats(host: str, port: int) -> dict:
         return client.stats()
 
 
+def parse_ps_targets(arg: str) -> list:
+    """``--ps`` target(s) -> [(host, port), ...]: a single HOST:PORT, a
+    comma-separated shard fleet, or a shard PLAN FILE path (the JSON a
+    ``ShardedParameterServer.write_plan`` emits — ISSUE 10)."""
+    if os.path.exists(arg):
+        with open(arg) as f:
+            doc = json.load(f)
+        targets = [(s["host"], int(s["port"]))
+                   for s in (doc.get("shards") or []) if "host" in s]
+        if not targets:
+            raise ValueError(f"plan file {arg} carries no shard addresses")
+        return targets
+    targets = []
+    for part in str(arg).split(","):
+        host, _, port = part.strip().rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"--ps expects HOST:PORT (single, "
+                             f"comma-separated fleet, or a plan file), "
+                             f"got {part.strip()!r}")
+        targets.append((host, int(port)))
+    return targets
+
+
+def summarize_ps_fleet(replies: list) -> str:
+    """ONE merged view over a shard fleet's ``stats`` replies (ISSUE 10):
+    the consistent merge itself is ``ps.shard``'s ``merge_fleet_stats``
+    (one definition, shared with ``ShardedPSClient.stats``); this adds
+    the per-shard balance table that makes placement skew visible —
+    commits/bytes per shard."""
+    from distkeras_tpu.ps.shard.client import merge_fleet_stats
+    head = {
+        **merge_fleet_stats(replies),
+        "server": f"{replies[0].get('server', '?')} "
+                  f"×{len(replies)} shards",
+        "num_workers": replies[0].get("num_workers", "?"),
+        # every shard's detector sees the same gap_s stream; one
+        # representative suffices for the merged view
+        "stragglers": replies[0].get("stragglers"),
+        "fleet": replies[0].get("fleet"),
+    }
+    lines = [summarize_stats(head)]
+    plan = replies[0].get("shard") or {}
+    lines += ["", "== Shard balance =="]
+    if plan:
+        lines.append(f"plan: shards={plan.get('num_shards', '?')}  "
+                     f"epoch={plan.get('epoch', '?')}  "
+                     f"digest={plan.get('digest', '?')}")
+    lines.append(f"{'shard':>5}  {'updates':>8}  {'commits':>8}  "
+                 f"{'share':>6}  {'bytes in':>12}  {'bytes out':>12}")
+    total = sum(_num(r.get("stats", {}).get("ps.commits", {})
+                     .get("value"), 0) for r in replies) or 1.0
+    for i, r in enumerate(replies):
+        s = r.get("stats", {})
+        commits = _num(s.get("ps.commits", {}).get("value"), 0)
+        idx = (r.get("shard") or {}).get("index", i)
+        lines.append(
+            f"{idx:>5}  {_num(r.get('num_updates'), 0):>8,.0f}  "
+            f"{commits:>8,.0f}  {100 * commits / total:>5.1f}%  "
+            f"{_num(s.get('net.bytes_recv', {}).get('value'), 0):>12,.0f}  "
+            f"{_num(s.get('net.bytes_sent', {}).get('value'), 0):>12,.0f}")
+    return "\n".join(lines)
+
+
 #: the serving SLO surface, rendered in this order (ISSUE 7)
 _SLO_HISTS = (("serve.queue_wait_seconds", "queue wait"),
               ("serve.ttft_seconds", "first token"),
@@ -702,8 +765,12 @@ def main(argv=None) -> int:
                     "drift-gate two registry snapshots")
     ap.add_argument("jsonl", nargs="?",
                     help="JSONL metrics file written by MetricsLogger")
-    ap.add_argument("--ps", metavar="HOST:PORT",
-                    help="poll a live SocketParameterServer's stats RPC")
+    ap.add_argument("--ps", metavar="TARGET",
+                    help="poll a live SocketParameterServer's stats RPC; "
+                         "a comma-separated HOST:PORT list or a shard "
+                         "plan file polls every shard of a sharded PS "
+                         "and renders ONE merged view with a per-shard "
+                         "balance table (ISSUE 10)")
     ap.add_argument("--serve", metavar="HOST:PORT",
                     help="poll a live decode service's stats RPC (SLO "
                          "latency table, admission counters, retrace "
@@ -747,12 +814,19 @@ def main(argv=None) -> int:
         return run_continual(args.continual)
 
     if args.ps:
-        host, _, port = args.ps.rpartition(":")
-        if not host or not port.isdigit():
-            ap.error(f"--ps expects HOST:PORT, got {args.ps!r}")
-        reply = poll_stats(host, int(port))
-        emit(to_prometheus_text(reply.get("stats", {})) if args.prometheus
-             else summarize_stats(reply))
+        try:
+            targets = parse_ps_targets(args.ps)
+        except (ValueError, OSError) as e:
+            ap.error(str(e))
+        replies = [poll_stats(h, p) for h, p in targets]
+        if args.prometheus:
+            from distkeras_tpu.obs import Registry
+            emit(to_prometheus_text(Registry.merge_snapshots(
+                *[r.get("stats", {}) for r in replies])))
+        elif len(replies) == 1:
+            emit(summarize_stats(replies[0]))
+        else:
+            emit(summarize_ps_fleet(replies))
         return 0
 
     if args.serve:
