@@ -34,14 +34,19 @@ sweep point beside the BENCH_r*.json files (the ROADMAP telemetry item)
 so runs can diff distributions, not just wall numbers.
 
 ``python bench.py --serve [--requests N] [--concurrency C]
-[--prompt-len P] [--max-new K] [--slots B] [--queue Q]`` runs the
-**decode-service load bench** (ISSUE 7): a localhost continuous-batching
-``ServeServer`` over a small gpt_lm, driven by C closed-loop client
-threads, printing one JSON row with p50/p99 end-to-end +
-time-to-first-token latency, tokens/sec and the load-shed count, and
-persisting the service registry snapshot (SLO histograms + admission
-counters + the zero-pinned ``jit.retraces`` sentinel) to
-``BENCH_SERVE_OBS.json``.
+[--prompt-len P] [--max-new K] [--slots B] [--queue Q] [--spec K]
+[--no-prefix]`` runs the **decode-service load bench** (ISSUE 7): a
+localhost continuous-batching ``ServeServer`` over a small gpt_lm,
+driven by C closed-loop client threads, printing one JSON row with
+p50/p99 end-to-end + time-to-first-token latency, tokens/sec and the
+load-shed count, and persisting the service registry snapshot (SLO
+histograms + admission counters + the zero-pinned ``jit.retraces``
+sentinel) to ``BENCH_SERVE_OBS.json``.  ISSUE 11 folds the two decode
+accelerators into the same row + snapshot: a warm-vs-cold **prefix
+phase** (ttft p50 with a shared cached prefix vs a cold prefill) and a
+**spec phase** (tokens/sec with and without speculative decoding, at
+exact greedy parity vs ``generate_tokens``) — both drift-gated, so a
+hit-rate or accept-rate regression fails like any perf regression.
 
 All benches self-check against the committed baseline snapshot named in
 ``OBS_BASELINE.json`` (ISSUE 5): the fresh run's registry snapshot is
@@ -282,11 +287,145 @@ def main():
     }))
 
 
+#: committed config of the warm-vs-cold prefix phase (ISSUE 11): a model
+#: big enough that prefill COMPUTE dominates the join (long seq_len, the
+#: O(T²) attention term) against a short suffix replay — the regime the
+#: prefix cache exists for.  ``shared`` is the system-prompt stand-in
+#: (a ``block`` multiple, so later prompts alias into the first entry);
+#: request 1 is the cold prefill, every later request warm-joins.
+SERVE_PREFIX_PHASE = dict(requests=6, vocab=128, dim=128, heads=4,
+                          blocks=2, seq_len=768, shared=744, tail=6,
+                          max_new=4, slots=2, suffix_bucket=8,
+                          cache_mb=512.0, block=8)
+
+#: committed config of the speculative-decode phase (ISSUE 11): a model
+#: small enough that per-dispatch overhead dominates decode compute —
+#: the regime where emitting k+1 tokens per dispatch pays on this host
+#: (on a real TPU the same mechanism amortizes the target's HBM weight
+#: read instead).  The draft is the TARGET ITSELF (accept rate 1.0):
+#: that measures the verify machinery's dispatch-amortization ceiling
+#: at guaranteed parity; a distilled smaller draft lands below it in
+#: accept rate but above it in per-proposal cost.
+SERVE_SPEC_PHASE = dict(k=4, requests=8, prompt_len=8, max_new=32,
+                        vocab=64, dim=32, heads=2, blocks=1, seq_len=64,
+                        slots=2)
+
+
+def _serve_prefix_phase(phase: dict):
+    """The warm-vs-cold ttft probe: serialized requests sharing a long
+    prefix through a prefix-cached engine — request 1 cold-prefills (and
+    populates the cache), the rest warm-join over the cached KV.
+    Returns the row fields + the engine registry snapshot (the
+    ``serve.ttft_{warm,cold}_seconds`` split and ``serve.prefix.*``
+    counters live there)."""
+    from distkeras_tpu.obs import Registry, snapshot_quantile
+    from distkeras_tpu.serve import DecodeEngine, ServeConfig
+
+    model = zoo.gpt_lm(vocab_size=phase["vocab"], dim=phase["dim"],
+                       num_heads=phase["heads"],
+                       num_blocks=phase["blocks"],
+                       seq_len=phase["seq_len"])
+    registry = Registry()
+    cfg = ServeConfig(slots=phase["slots"], max_queue=phase["requests"],
+                      max_new_tokens=phase["max_new"],
+                      prefill_buckets=(phase["suffix_bucket"],
+                                       phase["seq_len"]),
+                      prefix_cache=True, prefix_cache_mb=phase["cache_mb"],
+                      prefix_block=phase["block"])
+    engine = DecodeEngine(model, model.init(0), cfg, registry=registry)
+    engine.warmup()
+    rng = np.random.default_rng(11)
+    shared = rng.integers(0, phase["vocab"],
+                          size=(phase["shared"],)).astype(np.int32)
+    with engine:
+        for _ in range(phase["requests"]):
+            tail = rng.integers(0, phase["vocab"],
+                                size=(phase["tail"],)).astype(np.int32)
+            # serialized: each request completes before the next is
+            # submitted, so warm/cold attribution is deterministic
+            engine.submit(np.concatenate([shared, tail]),
+                          phase["max_new"]).result(timeout=600)
+    snap = registry.snapshot()
+    warm = snapshot_quantile(snap["serve.ttft_warm_seconds"], 0.5)
+    cold = snapshot_quantile(snap["serve.ttft_cold_seconds"], 0.5)
+    hits = snap["serve.prefix.hits"]["value"]
+    misses = snap["serve.prefix.misses"]["value"]
+    fields = {
+        "ttft_warm_ms_p50": round(warm * 1e3, 3),
+        "ttft_cold_ms_p50": round(cold * 1e3, 3),
+        "warm_speedup": round(cold / warm, 2) if warm > 0 else None,
+        "prefix_hit_rate": round(hits / (hits + misses), 3)
+        if hits + misses else 0.0,
+    }
+    return fields, snap
+
+
+def _serve_spec_phase(phase: dict):
+    """The speculative-decode probe: the same prompts through a plain
+    engine and a ``spec_k`` engine (draft = the target checkpoint, see
+    ``SERVE_SPEC_PHASE``), tokens/sec each way, exact-parity check of
+    every output against the offline ``generate_tokens`` reference.
+    Returns the row fields + both engine registry snapshots."""
+    from distkeras_tpu.models.generation import generate_tokens
+    from distkeras_tpu.obs import Registry
+    from distkeras_tpu.serve import DecodeEngine, ServeConfig
+
+    model = zoo.gpt_lm(vocab_size=phase["vocab"], dim=phase["dim"],
+                       num_heads=phase["heads"],
+                       num_blocks=phase["blocks"],
+                       seq_len=phase["seq_len"])
+    variables = model.init(0)
+    rng = np.random.default_rng(12)
+    prompts = [rng.integers(0, phase["vocab"],
+                            size=(phase["prompt_len"],)).astype(np.int32)
+               for _ in range(phase["requests"])]
+
+    def drive(spec_k: int):
+        registry = Registry()
+        kw = {}
+        if spec_k > 0:
+            kw = dict(draft_model=model, draft_variables=variables)
+        engine = DecodeEngine(
+            model, variables,
+            ServeConfig(slots=phase["slots"],
+                        max_queue=phase["requests"],
+                        max_new_tokens=phase["max_new"], spec_k=spec_k),
+            registry=registry, **kw)
+        engine.warmup()
+        with engine:
+            t0 = time.perf_counter()
+            reqs = [engine.submit(p, phase["max_new"]) for p in prompts]
+            outs = [r.result(timeout=600) for r in reqs]
+            wall = time.perf_counter() - t0
+        snap = registry.snapshot()
+        return snap["serve.tokens_out"]["value"] / wall, snap, outs
+
+    tps_base, snap_base, outs_base = drive(0)
+    tps_spec, snap_spec, outs_spec = drive(phase["k"])
+    parity = all(
+        np.array_equal(b, s) and np.array_equal(
+            s, np.asarray(generate_tokens(
+                model, variables, p[None, :],
+                phase["max_new"]))[0, len(p):])
+        for p, b, s in zip(prompts, outs_base, outs_spec))
+    fields = {
+        "spec_k": phase["k"],
+        "tokens_per_sec_base": round(tps_base, 1),
+        "tokens_per_sec_spec": round(tps_spec, 1),
+        "spec_uplift": round(tps_spec / tps_base, 2) if tps_base else None,
+        "spec_accept_rate": round(
+            snap_spec["serve.spec.accept_rate"]["value"], 3),
+        "spec_parity": parity,
+    }
+    return fields, snap_base, snap_spec
+
+
 def bench_serve(requests: int = 32, concurrency: int = 4,
                 prompt_len: int = 12, max_new: int = 16, slots: int = 4,
                 queue: int = 8, out_dir: str = ROOT, wire_version=None,
                 vocab: int = 64, dim: int = 32, heads: int = 2,
-                blocks: int = 1, seq_len: int = 64) -> dict:
+                blocks: int = 1, seq_len: int = 64, prefix_phase=None,
+                spec_phase=None) -> dict:
     """Decode-service load bench (ISSUE 7 acceptance): a localhost
     ``ServeServer`` over a small ``gpt_lm`` and ``concurrency``
     closed-loop client threads driving ``requests`` generations through
@@ -299,7 +438,25 @@ def bench_serve(requests: int = 32, concurrency: int = 4,
     persist to ``BENCH_SERVE_OBS.json`` beside the BENCH_r*.json files,
     drift-checked against the committed baseline BEFORE overwriting it
     (the same ``OBS_BASELINE.json`` contract as the trainer/PS benches;
-    config-incompatible runs divert to a ``.variant.json`` sidecar)."""
+    config-incompatible runs divert to a ``.variant.json`` sidecar).
+
+    ISSUE 11 adds two accelerator phases to the same row + snapshot
+    (each a dict of overrides onto ``SERVE_PREFIX_PHASE`` /
+    ``SERVE_SPEC_PHASE``; ``False`` skips the phase, leaving its row
+    fields ``None`` — explicitly absent, not missing):
+
+    * **prefix phase** — warm-vs-cold ttft over a long shared prefix
+      (``ttft_warm_ms_p50`` / ``ttft_cold_ms_p50`` / ``warm_speedup`` /
+      ``prefix_hit_rate``; snapshot part ``"prefix"``).
+    * **spec phase** — tokens/sec with and without speculative decoding
+      at exact greedy parity vs ``generate_tokens``
+      (``tokens_per_sec_base`` / ``tokens_per_sec_spec`` /
+      ``spec_uplift`` / ``spec_accept_rate`` / ``spec_parity``;
+      snapshot parts ``"spec_base"`` / ``"spec"``).
+
+    Both phases' registry snapshots ride in the SAME drift-gated
+    ``BENCH_SERVE_OBS.json``, so a future hit-rate or accept-rate
+    regression fails the gate like any perf regression."""
     from distkeras_tpu.models import zoo
     from distkeras_tpu.obs import Registry, snapshot_quantile
     from distkeras_tpu.serve import (DecodeEngine, ServeClient,
@@ -394,6 +551,27 @@ def bench_serve(requests: int = 32, concurrency: int = 4,
         "jit_retraces": snap["jit.retraces"]["value"],
         "wire_version": min(negotiated),
     }
+
+    # -- accelerator phases (ISSUE 11): row fields are ALWAYS present
+    # (None when a phase is skipped), snapshot parts only when run
+    prefix_cfg = None if prefix_phase is False \
+        else {**SERVE_PREFIX_PHASE, **(prefix_phase or {})}
+    spec_cfg = None if spec_phase is False \
+        else {**SERVE_SPEC_PHASE, **(spec_phase or {})}
+    row.update(dict.fromkeys(
+        ("ttft_warm_ms_p50", "ttft_cold_ms_p50", "warm_speedup",
+         "prefix_hit_rate", "spec_k", "tokens_per_sec_base",
+         "tokens_per_sec_spec", "spec_uplift", "spec_accept_rate",
+         "spec_parity")))
+    parts = {}
+    if prefix_cfg is not None:
+        fields, parts["prefix"] = _serve_prefix_phase(prefix_cfg)
+        row.update(fields)
+    if spec_cfg is not None:
+        fields, parts["spec_base"], parts["spec"] = \
+            _serve_spec_phase(spec_cfg)
+        row.update(fields)
+
     bl_cfg = _baseline_cfg()
     base_path = _baseline_snapshot_path(bl_cfg, "serve_bench",
                                         "BENCH_SERVE_OBS.json")
@@ -405,9 +583,18 @@ def bench_serve(requests: int = 32, concurrency: int = 4,
                           "model": {"vocab": vocab, "dim": dim,
                                     "heads": heads, "blocks": blocks,
                                     "seq_len": seq_len},
+                          "prefix_phase": prefix_cfg,
+                          "spec_phase": spec_cfg,
                           **cfg.config_row(seq_len)},
+               # the wall-clock row rides in the committed artifact too:
+               # the acceptance numbers (warm_speedup, spec_uplift,
+               # spec_parity) are then inspectable from the snapshot
+               # alone.  Not a registry part — diff_docs skips it; the
+               # drift gate works on the distributions above instead
+               "row": dict(row),
                "client": merged,
-               "server": snap}
+               "server": snap,
+               **parts}
     snap_path = os.path.join(out_dir, os.path.basename(base_path))
     row["obs_drift"], snap_path = _persist_obs_snapshot(
         snap_path, obs_doc, bl_cfg, base_path=base_path)
@@ -749,6 +936,14 @@ def _cli(argv=None) -> int:
                     help="bench_serve: continuous-batch width")
     ap.add_argument("--queue", type=int, default=8,
                     help="bench_serve: admission queue bound")
+    ap.add_argument("--spec", type=int, default=None, metavar="K",
+                    help="bench_serve: draft tokens per speculative "
+                         "step for the spec phase (default: the "
+                         "committed SERVE_SPEC_PHASE k; 0 skips the "
+                         "phase)")
+    ap.add_argument("--no-prefix", action="store_true",
+                    help="bench_serve: skip the warm-vs-cold prefix "
+                         "phase")
     ap.add_argument("--codec", default="none",
                     help="bench_ps commit codec: none|int8|bf16|topk<frac>")
     ap.add_argument("--windows", type=int, default=50,
@@ -787,11 +982,16 @@ def _cli(argv=None) -> int:
     if args.serve:
         if args.requests < 1 or args.concurrency < 1:
             ap.error("--requests and --concurrency must be >= 1")
+        if args.spec is not None and args.spec < 0:
+            ap.error("--spec must be >= 0 (0 skips the spec phase)")
         print(json.dumps(bench_serve(
             requests=args.requests, concurrency=args.concurrency,
             prompt_len=args.prompt_len, max_new=args.max_new,
             slots=args.slots, queue=args.queue,
-            wire_version=args.wire)))
+            wire_version=args.wire,
+            prefix_phase=False if args.no_prefix else None,
+            spec_phase=False if args.spec == 0
+            else None if args.spec is None else {"k": args.spec})))
         return 0
     if args.ps:
         try:

@@ -159,6 +159,40 @@ def _filter_logits(logits, top_k, top_p):
     return logits
 
 
+def decode_window(layer, params, state, tokens, cache, start, limit=None):
+    """Cached multi-token decode window: feed ``tokens`` (B, K) through
+    ``layer.apply_decode`` sequentially at positions ``start + i``
+    (``start`` scalar or (B,) — per-row ragged windows work), returning
+    the per-position logits (B, K, V) and the advanced cache.
+
+    This is the chunked-decode primitive both serving accelerators build
+    on (ISSUE 11): the prefix cache's *suffix prefill* (re-play only the
+    uncached tail of a prompt over a cached KV prefix) and the
+    speculative-decode *batched verify step* (K proposed tokens through
+    the target in ONE compiled program instead of K dispatches).  Each
+    position's K/V is written before it is attended, so the window is
+    exact wherever a position-by-position decode would be.
+
+    ``limit`` (the model's seq_len) clamps every write position to
+    ``limit - 1``: callers may pad the window past a row's real content,
+    and a clamped slot is placeholder-overwritten by a later real write
+    before any kept logit attends it — same contract as prefill padding.
+    Trace-safe: call inside jit (it compiles a ``lax.scan``)."""
+    k = int(tokens.shape[1])
+    start = jnp.asarray(start, jnp.int32)
+    cap = None if limit is None else int(limit) - 1
+
+    def step(c, i):
+        pos = start + i
+        if cap is not None:
+            pos = jnp.minimum(pos, cap)
+        logits, c = layer.apply_decode(params, state, tokens[:, i], c, pos)
+        return c, logits
+
+    cache, ls = lax.scan(step, cache, jnp.arange(k))
+    return jnp.moveaxis(ls, 0, 1), cache
+
+
 def generate_tokens(model, variables, prompt, num_steps: int,
                     temperature: float = 0.0, seed: int = 0,
                     use_cache=None, top_k=None, top_p=None,

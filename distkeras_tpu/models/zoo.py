@@ -265,6 +265,27 @@ def gpt_lm(vocab_size: int = 256, dim: int = 128, num_heads: int = 4,
     return Model(Sequential(layers), input_shape=(seq_len,), name="gpt_lm")
 
 
+def draft_lm(target: Model, dim: int = 32, num_heads: int = 2,
+             num_blocks: int = 1, ff_mult: int = 4,
+             positional: str = "learned") -> Model:
+    """A small **draft** model for speculative decoding (ISSUE 11),
+    shape-compatible with a ``gpt_lm`` ``target`` by construction: same
+    vocab (proposals are verified token-by-token in one shared id
+    space) and same ``seq_len`` (the draft's KV cache tracks the same
+    absolute positions as the target's), everything else scaled down.
+    ``DecodeEngine(..., draft_model=..., draft_variables=...)`` verifies
+    exactly these two invariants at construction — this helper makes
+    them impossible to get wrong.
+
+    The draft's *weights* are the caller's problem (typically a
+    distillation of the target): speculative decoding is greedy-exact at
+    ANY draft quality, a bad draft only costs accept rate."""
+    return gpt_lm(vocab_size=int(target.output_shape[-1]), dim=dim,
+                  num_heads=num_heads, num_blocks=num_blocks,
+                  seq_len=int(target.input_shape[0]), ff_mult=ff_mult,
+                  positional=positional)
+
+
 ZOO = {
     "mlp_mnist": mlp_mnist,
     "convnet_mnist": convnet_mnist,

@@ -48,6 +48,27 @@ class ServeConfig:
     * ``drain_timeout_s`` — graceful-drain bound: how long ``drain()``
       waits for in-flight requests before aborting them (aborts are
       recorded as rejections — nothing drops silently).
+    * ``prefix_cache`` / ``prefix_cache_mb`` — ISSUE 11 decode
+      accelerator #1: cache admitted prompts' device-side KV slices
+      keyed by their token prefix, so a later prompt sharing a prefix
+      re-plays only its *suffix* over the cached KV (a short compiled
+      decode window) instead of re-prefilling from token 0.  The LRU
+      over cached slices is bounded by ``prefix_cache_mb`` (must be > 0
+      when the cache is enabled — an unbounded device-memory cache is a
+      config error, the ``max_queue=0`` rejection precedent).
+    * ``prefix_block`` — prefix-match granularity in tokens: every
+      cached prompt is findable at each ``prefix_block`` boundary of
+      its content, so two prompts sharing a system prefix hit each
+      other's entries without either being a strict prefix of the
+      other.  Smaller blocks match more, cost more lookup hashing.
+    * ``spec_k`` — ISSUE 11 decode accelerator #2: speculative decoding.
+      0 disables; k >= 1 makes a small *draft* model (passed to
+      ``DecodeEngine``) propose k tokens per active row per step, which
+      the target verifies in ONE batched decode window — accepted-prefix
+      rollback keeps the ragged KV cache exact and greedy output
+      provably equals ``generate_tokens``.  Greedy-only: requires
+      ``temperature == 0`` (distribution-preserving speculative
+      *sampling* is a follow-on, see ROADMAP).
     """
 
     slots: int = 4
@@ -60,6 +81,10 @@ class ServeConfig:
     eos_id: Optional[int] = None
     seed: int = 0
     drain_timeout_s: float = 30.0
+    prefix_cache: bool = False
+    prefix_cache_mb: float = 64.0
+    prefix_block: int = 16
+    spec_k: int = 0
 
     def __post_init__(self):
         if int(self.slots) < 1:
@@ -77,6 +102,27 @@ class ServeConfig:
             raise ValueError(f"top_k must be >= 1, got {self.top_k}")
         if self.top_p is not None and not 0.0 < float(self.top_p) <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        # the new-knob validation happens HERE, at config time — the
+        # max_queue=0 precedent: a config that can only misbehave is
+        # rejected before an engine (or a fleet of them) is built on it
+        if self.prefix_cache and not float(self.prefix_cache_mb) > 0.0:
+            raise ValueError(
+                f"prefix_cache_mb must be > 0 when the prefix cache is "
+                f"enabled (it bounds the device-side KV LRU), got "
+                f"{self.prefix_cache_mb}")
+        if int(self.prefix_block) < 1:
+            raise ValueError(f"prefix_block must be >= 1, got "
+                             f"{self.prefix_block}")
+        if int(self.spec_k) < 0:
+            raise ValueError(f"spec_k must be >= 0 (0 disables "
+                             f"speculative decode), got {self.spec_k}")
+        if int(self.spec_k) > 0 and float(self.temperature) != 0.0:
+            raise ValueError(
+                f"speculative decode is greedy-only (spec_k="
+                f"{self.spec_k} with temperature={self.temperature}): "
+                f"verified acceptance proves argmax parity; "
+                f"distribution-preserving speculative sampling is not "
+                f"implemented")
 
     def resolved_buckets(self, seq_len: int) -> Tuple[int, ...]:
         """The ascending prefill-bucket lengths for a ``seq_len`` model:
@@ -119,4 +165,10 @@ class ServeConfig:
             "top_k": None if self.top_k is None else int(self.top_k),
             "top_p": None if self.top_p is None else float(self.top_p),
             "eos_id": None if self.eos_id is None else int(self.eos_id),
+            "prefix_cache": bool(self.prefix_cache),
+            "prefix_cache_mb": float(self.prefix_cache_mb)
+            if self.prefix_cache else None,
+            "prefix_block": int(self.prefix_block)
+            if self.prefix_cache else None,
+            "spec_k": int(self.spec_k),
         }

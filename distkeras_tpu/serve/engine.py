@@ -1,35 +1,74 @@
-"""Continuous-batching decode engine (ISSUE 7 tentpole).
+"""Continuous-batching decode engine (ISSUE 7 tentpole; ISSUE 11 made
+the hot path fast: prefix KV cache, speculative decoding, dispatch-ahead).
 
 One decode state for ``slots`` concurrent requests — buffer (B, T),
-KV cache (B rows), per-row position/logits — advanced one token per
-``step`` for every ACTIVE row, exactly the ragged per-row read/write
-machinery ``models.generation`` already compiles (one-hot position
-writes, (B,) cache positions).  A new request does not wait for the
-batch to finish: a **join** program prefills the prompt at its length
-bucket and scatters the row (buffer, padded cache, position, first-token
-logits) into a retired slot while the other rows keep decoding.
+KV cache (B rows), per-row position/logits — advanced for every ACTIVE
+row per ``step``, exactly the ragged per-row read/write machinery
+``models.generation`` already compiles (one-hot position writes, (B,)
+cache positions).  A new request does not wait for the batch to finish:
+a **join** program prefills the prompt at its length bucket and scatters
+the row (buffer, padded cache, position, first-token logits) into a
+retired slot while the other rows keep decoding.
 
-Three compiled-program families, all static-shaped by construction:
+Compiled-program families, all static-shaped by construction:
 
 * ``serve.join.l<L>`` — per prefill bucket L: single-row prefill of the
-  (1, L) padded prompt + one-hot scatter into slot ``row``.
-* ``serve.step`` — sample every active row's next token from its carried
-  logits, write it at the row's own position, one cached decode forward
-  for the next position's logits.  Inactive rows are masked no-ops.
+  (1, L) padded prompt + one-hot scatter into slot ``row``.  With the
+  prefix cache on, the join also RETURNS the single-row full-length KV
+  it just computed, so the host can cache it for later prompts sharing
+  the prefix.  With speculative decode on, the join prefills the draft
+  model's cache for the row too.
+* ``serve.sjoin.s<S>`` — per suffix bucket S (prefix cache on): admit a
+  prompt whose longest prefix is already cached by re-playing only the
+  (1, S) padded *suffix* over the cached KV (a ``decode_window``) + the
+  same one-hot scatter — warm time-to-first-token skips the O(L²)
+  prefill entirely.
+* ``serve.step`` — sample every active row's next token from its
+  carried logits, write it at the row's own position, one cached decode
+  forward for the next position's logits.  Inactive rows are masked
+  no-ops.
+* ``serve.spec_step`` (``spec_k > 0``, replaces ``serve.step``) — draft
+  proposes k tokens per row, the target verifies all k in one batched
+  window, up to k+1 tokens emitted per dispatch (``serve/spec.py``;
+  greedy output provably equals ``generate_tokens``).
 * Each program sits behind its own ``RetraceSentinel``
   (``jit.compiles``/``jit.retraces`` in the service registry) — after
-  ``warmup()`` compiles the full bucket ladder, steady-state serving is
-  provably ``jit.retraces == 0`` (the drift-gated serving contract).
+  ``warmup()`` compiles the full ladder (buckets × {join, sjoin} + the
+  step), steady-state serving is provably ``jit.retraces == 0`` (the
+  drift-gated serving contract).
+
+**Dispatch-ahead** (ISSUE 11 satellite): the decode loop dispatches
+device step k+1 BEFORE doing step k's host bookkeeping (readback,
+detokenize, retire, SLO stamping), so the host component overlaps the
+in-flight device step instead of serializing with it — steady-state
+step cadence approaches max(device, host) rather than device + host.
+``serve.host_seconds`` records the per-step host component that is now
+hidden.  Token attribution stays exact: each dispatch snapshots its
+slot->request map, and a token computed for a row that retired (or
+re-joined) after the dispatch is discarded by the snapshot check.
+Under this overlap ``serve.step_seconds`` (and ``per_token_seconds``,
+which replays it per token) measures a step's dispatch->retire wall —
+one full loop iteration, INCLUDING the host work overlapped with the
+in-flight step (previous retire, any admit-time prefill joins, the
+next dispatch).  It is the steady-state step *cadence* the SLO gate
+should track, not isolated device time; on a join-heavy workload its
+tail moves with admission, which is precisely the latency a caller
+experiences.
 
 Scheduling is host-side and single-threaded: one decode thread owns the
 device state and the slot table; ``submit()`` (any thread) only touches
 the bounded admission queue.  SLO surface, all in the service registry:
 ``serve.queue_wait_seconds`` (submit -> slot), ``serve.ttft_seconds``
-(submit -> first token), ``serve.per_token_seconds`` (each emitted
-token's step wall), ``serve.e2e_seconds`` (submit -> done),
-``serve.step_seconds``, counters ``serve.requests`` / ``serve.admitted``
-/ ``serve.completed`` / ``serve.tokens_out`` / ``serve.rejected`` (split
-by reason), gauges ``serve.queue_depth`` / ``serve.active_slots``.
+(submit -> first token; split ``serve.ttft_warm_seconds`` /
+``serve.ttft_cold_seconds`` by prefix-cache outcome),
+``serve.per_token_seconds`` (each emitted token's step cadence),
+``serve.e2e_seconds`` (submit -> done), ``serve.step_seconds``,
+``serve.host_seconds``, counters ``serve.requests`` /
+``serve.admitted`` / ``serve.completed`` / ``serve.tokens_out`` /
+``serve.rejected`` (split by reason), ``serve.prefix.*`` /
+``serve.spec.*`` accelerator counters (pre-created, so a snapshot
+always carries explicit zeros), gauges ``serve.queue_depth`` /
+``serve.active_slots``.
 
 Admission control: a full queue (or a draining engine) load-sheds with
 ``ServeRejected`` — every request either completes or is recorded under
@@ -49,8 +88,10 @@ import numpy as np
 from ..obs import Registry, TIME_BUCKETS
 from ..obs.logging import get_logger
 from ..obs.profile import RetraceSentinel
-from ..models.generation import _filter_logits, _model_cache
+from ..models.generation import _filter_logits, _model_cache, decode_window
 from .config import ServeConfig
+from .prefix import PrefixCache, PrefixEntry
+from .spec import build_spec_step, validate_draft
 
 _LOG = "serve.engine"
 
@@ -73,11 +114,13 @@ class ServeRequest:
 
     ``wait(timeout)`` blocks until completion; ``result()`` returns the
     GENERATED token ids (eos included when sampled) as int32, raising
-    ``ServeRejected`` if the engine aborted the request mid-flight."""
+    ``ServeRejected`` if the engine aborted the request mid-flight.
+    ``warm`` records the prefix-cache outcome at admission (None when
+    the cache is disabled)."""
 
     __slots__ = ("prompt", "length", "max_new", "tokens", "error",
                  "submit_t", "admit_t", "first_token_t", "done_t",
-                 "_done")
+                 "warm", "_done")
 
     def __init__(self, prompt: np.ndarray, max_new: int):
         self.prompt = prompt
@@ -89,6 +132,7 @@ class ServeRequest:
         self.admit_t: Optional[float] = None
         self.first_token_t: Optional[float] = None
         self.done_t: Optional[float] = None
+        self.warm: Optional[bool] = None
         self._done = threading.Event()
 
     @property
@@ -115,14 +159,35 @@ class _Slot:
         self.request: Optional[ServeRequest] = None
 
 
+class _Pending:
+    """One dispatched-but-not-yet-retired device step: the device output
+    handles plus the dispatch-time slot->request snapshot that makes
+    token attribution exact under dispatch-ahead."""
+
+    __slots__ = ("reqs", "tokens", "counts", "t0")
+
+    def __init__(self, reqs, tokens, counts, t0):
+        self.reqs = reqs          # slot->request snapshot at dispatch
+        self.tokens = tokens      # device (B,) or (B, k+1) int32
+        self.counts = counts      # device (B,) int32, or None (plain)
+        self.t0 = t0
+
+
 class DecodeEngine:
     """The scheduler/batcher.  ``start()`` spawns the decode thread;
     ``submit()`` is thread-safe; ``drain()`` stops admission and waits
     for in-flight work; ``stop()`` is drain + shutdown (hard stop after
-    ``drain_timeout_s``, aborted requests recorded as rejections)."""
+    ``drain_timeout_s``, aborted requests recorded as rejections).
+
+    ``draft_model``/``draft_variables`` (required iff
+    ``config.spec_k > 0``): the small proposal model for speculative
+    decoding — validated shape-compatible HERE, at construction, never
+    discovered by the decode thread (the config-time-rejection
+    precedent)."""
 
     def __init__(self, model, variables, config: Optional[ServeConfig] = None,
-                 registry: Optional[Registry] = None):
+                 registry: Optional[Registry] = None, draft_model=None,
+                 draft_variables=None):
         import jax
 
         self.model = model
@@ -145,6 +210,22 @@ class DecodeEngine:
         out_shape = model.output_shape
         self._vocab = int(out_shape[-1])
 
+        # -- speculative decode (ISSUE 11): draft model, validated now --
+        self._spec_k = int(self.config.spec_k)
+        self.draft_model = draft_model
+        if self._spec_k > 0:
+            validate_draft(model, draft_model, draft_variables, self._b,
+                           self._spec_k)
+            self._draft_variables = jax.tree_util.tree_map(
+                jax.numpy.asarray, draft_variables)
+        else:
+            if draft_model is not None or draft_variables is not None:
+                raise ValueError(
+                    "draft_model/draft_variables passed but spec_k == 0 "
+                    "— speculative decode would silently never run; set "
+                    "ServeConfig(spec_k=K) or drop the draft")
+            self._draft_variables = None
+
         #: variables live on device once — per-call host->device transfer
         #: of the whole parameter tree would dwarf a decode step
         self._variables = jax.tree_util.tree_map(jax.numpy.asarray,
@@ -159,6 +240,7 @@ class DecodeEngine:
         # exactly once and any later signature change is a real retrace)
         self._step_fn = None
         self._join_fns: dict = {}
+        self._sjoin_fns: dict = {}
         self._sentinels: dict = {}
         # pre-create the sentinel counters so a snapshot taken before any
         # traffic carries an explicit 0 (a missing metric is only a drift
@@ -170,10 +252,15 @@ class DecodeEngine:
         self._h_queue_wait = reg.histogram("serve.queue_wait_seconds",
                                            TIME_BUCKETS)
         self._h_ttft = reg.histogram("serve.ttft_seconds", TIME_BUCKETS)
+        self._h_ttft_warm = reg.histogram("serve.ttft_warm_seconds",
+                                          TIME_BUCKETS)
+        self._h_ttft_cold = reg.histogram("serve.ttft_cold_seconds",
+                                          TIME_BUCKETS)
         self._h_per_token = reg.histogram("serve.per_token_seconds",
                                           TIME_BUCKETS)
         self._h_e2e = reg.histogram("serve.e2e_seconds", TIME_BUCKETS)
         self._h_step = reg.histogram("serve.step_seconds", TIME_BUCKETS)
+        self._h_host = reg.histogram("serve.host_seconds", TIME_BUCKETS)
         self._h_join = reg.histogram("serve.join_seconds", TIME_BUCKETS)
         self._c_requests = reg.counter("serve.requests")
         self._c_admitted = reg.counter("serve.admitted")
@@ -188,6 +275,21 @@ class DecodeEngine:
         self._c_rej_abort = reg.counter("serve.rejected_aborted")
         self._g_queue = reg.gauge("serve.queue_depth")
         self._g_active = reg.gauge("serve.active_slots")
+        # accelerator metrics are ALWAYS pre-created — a disabled
+        # engine's snapshot carries explicit zeros, not missing metrics
+        # (the drift gate's present-0 contract, and the bench satellite)
+        self._c_spec_proposed = reg.counter("serve.spec.proposed")
+        self._c_spec_accepted = reg.counter("serve.spec.accepted")
+        self._g_accept_rate = reg.gauge("serve.spec.accept_rate")
+        for name in ("hits", "misses", "inserts", "evictions"):
+            reg.counter(f"serve.prefix.{name}")
+        reg.gauge("serve.prefix.bytes")
+        reg.gauge("serve.prefix.entries")
+        self._prefix = None
+        if self.config.prefix_cache:
+            self._prefix = PrefixCache(
+                int(float(self.config.prefix_cache_mb) * 1024 * 1024),
+                reg, block=int(self.config.prefix_block))
 
         #: admission queue + flags — the ONLY state shared across threads;
         #: every touch goes through _lock (slot table and device state are
@@ -215,6 +317,21 @@ class DecodeEngine:
         self._pos = jnp.zeros((b,), jnp.int32)
         self._logits = jnp.zeros((b, self._vocab), jnp.float32)
         self._rng = jax.random.PRNGKey(int(self.config.seed))
+        if self._spec_k > 0:
+            self._dcache = _model_cache(self.draft_model, b)
+            self._dlogits = jnp.zeros((b, self._vocab), jnp.float32)
+        else:
+            self._dcache = None
+            self._dlogits = None
+
+    def _single_row_cache(self, batch_cache):
+        """A zeroed single-row, full-length cache tree shaped like one
+        row of ``batch_cache`` — the warmup stand-in for a prefix-cache
+        entry."""
+        import jax
+        import jax.numpy as jnp
+        return jax.tree_util.tree_map(
+            lambda c: jnp.zeros((1,) + c.shape[1:], c.dtype), batch_cache)
 
     # -- compiled programs --------------------------------------------------
     def _sentinel(self, name: str) -> RetraceSentinel:
@@ -224,9 +341,24 @@ class DecodeEngine:
                 f"serve.{name}", registry=lambda: self.registry)
         return s
 
+    def _scatter_row(self, batch_tree, row_tree, oh):
+        """Blend single-row ``row_tree`` (leaves (1, T, ...)) into slot
+        ``oh`` (one-hot over B) of ``batch_tree`` — the join scatter."""
+        import jax
+
+        def scatter(c, c1):
+            ohx = oh.reshape((self._b,) + (1,) * (c.ndim - 1)).astype(
+                c.dtype)
+            return c * (1 - ohx) + c1.astype(c.dtype) * ohx
+
+        return jax.tree_util.tree_map(scatter, batch_tree, row_tree)
+
     def _join_fn(self, bucket: int):
         """The bucket's compiled join: single-row prefill of the (1, L)
-        padded prompt + scatter into slot ``row`` of the batch state."""
+        padded prompt + scatter into slot ``row`` of the batch state.
+        With spec on, the draft prefills alongside; with the prefix
+        cache on, the full-length single-row KV (and token row) it just
+        computed is RETURNED for the host to cache."""
         import jax
         import jax.numpy as jnp
 
@@ -234,46 +366,135 @@ class DecodeEngine:
         if fn is not None:
             return fn
         model, b, t, length_cap = self.model, self._b, self._t, bucket
+        draft = self.draft_model if self._spec_k > 0 else None
+        capture = self._prefix is not None
 
-        def _join(variables, buf, cache, pos, logits, prompt, length, row):
-            params, state = variables["params"], variables["state"]
-            cache1 = model.layer.init_cache(1, (length_cap,))
-            y, cache1 = model.layer.apply_prefill(params, state, prompt,
-                                                  cache1)
+        def _prefill_row(layer, params, state, prompt, length, cache):
+            """Single-row bucket prefill -> (last logits (1, V),
+            full-length row cache tree)."""
+            cache1 = layer.init_cache(1, (length_cap,))
+            y, cache1 = layer.apply_prefill(params, state, prompt, cache1)
             sel = jax.nn.one_hot(length - 1, length_cap, dtype=y.dtype)
             logits0 = jnp.einsum("btv,t->bv", y, sel)      # (1, V)
 
-            oh = jax.nn.one_hot(row, b)                     # (B,) float
-            is_row = jnp.arange(b) == row
-
-            def scatter(c, c1):
+            def pad_full(c1, c):
                 pad = [(0, 0)] * c1.ndim
                 pad[1] = (0, c.shape[1] - c1.shape[1])
-                c1p = jnp.pad(c1, pad).astype(c.dtype)
-                ohx = oh.reshape((b,) + (1,) * (c.ndim - 1)).astype(c.dtype)
-                return c * (1 - ohx) + c1p * ohx
+                return jnp.pad(c1, pad).astype(c.dtype)
 
-            cache = jax.tree_util.tree_map(scatter, cache, cache1)
+            return logits0, jax.tree_util.tree_map(pad_full, cache1,
+                                                   cache)
+
+        def _join(variables, dvariables, buf, cache, pos, logits, dcache,
+                  dlogits, prompt, length, row):
+            params, state = variables["params"], variables["state"]
+            logits0, c1p = _prefill_row(model.layer, params, state,
+                                        prompt, length, cache)
+            oh = jax.nn.one_hot(row, b)                     # (B,) float
+            is_row = jnp.arange(b) == row
+            cache = self._scatter_row(cache, c1p, oh)
             prow = jnp.zeros((t,), jnp.int32).at[:length_cap].set(prompt[0])
             ohi = oh.astype(jnp.int32)[:, None]
             buf = buf * (1 - ohi) + prow[None, :] * ohi
             pos = jnp.where(is_row, length, pos)
             logits = jnp.where(is_row[:, None],
                                logits0.astype(logits.dtype), logits)
-            return buf, cache, pos, logits
+            outs = [buf, cache, pos, logits]
+            dc1p = None
+            if draft is not None:
+                dlogits0, dc1p = _prefill_row(
+                    draft.layer, dvariables["params"],
+                    dvariables["state"], prompt, length, dcache)
+                outs += [self._scatter_row(dcache, dc1p, oh),
+                         jnp.where(is_row[:, None],
+                                   dlogits0.astype(dlogits.dtype),
+                                   dlogits)]
+            if capture:
+                outs += [prow[None, :], c1p]
+                if draft is not None:
+                    outs.append(dc1p)
+            return tuple(outs)
 
         fn = self._join_fns[bucket] = jax.jit(_join)
         return fn
 
+    def _sjoin_fn(self, bucket: int):
+        """The suffix bucket's compiled warm join (prefix cache on):
+        re-play the (1, S) padded suffix over a cached single-row prefix
+        KV with a ``decode_window``, then the same scatter the cold join
+        does.  The advanced row cache (now prefix + suffix) is returned
+        for the host to cache under the full prompt."""
+        import jax
+        import jax.numpy as jnp
+
+        fn = self._sjoin_fns.get(bucket)
+        if fn is not None:
+            return fn
+        model, b, t, s_cap = self.model, self._b, self._t, bucket
+        draft = self.draft_model if self._spec_k > 0 else None
+
+        def _replay(layer, params, state, suffix, slen, pcache, plen):
+            win, pcache2 = decode_window(layer, params, state, suffix,
+                                         pcache, plen, limit=t)
+            sel = jax.nn.one_hot(slen - 1, s_cap, dtype=win.dtype)
+            return jnp.einsum("bsv,s->bv", win, sel), pcache2
+
+        def _sjoin(variables, dvariables, buf, cache, pos, logits,
+                   dcache, dlogits, ptoks, pcache, pdcache, plen, suffix,
+                   slen, row):
+            params, state = variables["params"], variables["state"]
+            logits0, pcache2 = _replay(model.layer, params, state,
+                                       suffix, slen, pcache, plen)
+            # token row: the cached prefix row with the suffix written at
+            # plen .. plen+slen-1 (padded suffix positions masked out)
+            sidx = jnp.arange(s_cap)
+            wmat = jax.nn.one_hot(plen + sidx, t, dtype=jnp.int32) * \
+                (sidx < slen)[:, None].astype(jnp.int32)    # (S, T)
+            mask = wmat.sum(0)
+            prow = ptoks[0] * (1 - mask) + \
+                (suffix[0][:, None] * wmat).sum(0)
+            oh = jax.nn.one_hot(row, b)
+            is_row = jnp.arange(b) == row
+            cache = self._scatter_row(cache, pcache2, oh)
+            ohi = oh.astype(jnp.int32)[:, None]
+            buf = buf * (1 - ohi) + prow[None, :] * ohi
+            pos = jnp.where(is_row, plen + slen, pos)
+            logits = jnp.where(is_row[:, None],
+                               logits0.astype(logits.dtype), logits)
+            outs = [buf, cache, pos, logits]
+            pdcache2 = None
+            if draft is not None:
+                dlogits0, pdcache2 = _replay(
+                    draft.layer, dvariables["params"],
+                    dvariables["state"], suffix, slen, pdcache, plen)
+                outs += [self._scatter_row(dcache, pdcache2, oh),
+                         jnp.where(is_row[:, None],
+                                   dlogits0.astype(dlogits.dtype),
+                                   dlogits)]
+            outs += [prow[None, :], pcache2]
+            if draft is not None:
+                outs.append(pdcache2)
+            return tuple(outs)
+
+        fn = self._sjoin_fns[bucket] = jax.jit(_sjoin)
+        return fn
+
     def _build_step(self):
-        """One continuous-batching decode step: every ACTIVE row samples
-        its next token from the carried logits, writes it at its own
-        position, and runs one cached decode forward; inactive rows are
-        masked no-ops (their state is replaced wholesale at join)."""
+        """The per-dispatch decode program.  Plain mode: every ACTIVE
+        row samples its next token from the carried logits, writes it at
+        its own position, and runs one cached decode forward; inactive
+        rows are masked no-ops (their state is replaced wholesale at
+        join).  Spec mode (``spec_k > 0``): the draft-propose /
+        target-verify window from ``serve/spec.py`` — up to k+1 tokens
+        per row per dispatch."""
         import jax
         import jax.numpy as jnp
 
         if self._step_fn is not None:
+            return self._step_fn
+        if self._spec_k > 0:
+            self._step_fn = jax.jit(build_spec_step(
+                self.model, self.draft_model, self._spec_k))
             return self._step_fn
         model, t = self.model, self._t
         temperature = float(self.config.temperature)
@@ -315,27 +536,74 @@ class DecodeEngine:
         self._thread.start()
         return self
 
+    def _join_args(self, prompt, length, row):
+        """The cold join's observed-arg tuple (everything but the
+        variables trees) — ONE builder shared by warmup and _admit, so
+        their signatures can never drift apart."""
+        args = [self._buf, self._cache, self._pos, self._logits]
+        if self._spec_k > 0:
+            args += [self._dcache, self._dlogits]
+        else:
+            args += [None, None]
+        return tuple(args) + (prompt, np.int32(length), np.int32(row))
+
+    def _sjoin_args(self, entry_tokens, entry_cache, entry_dcache, plen,
+                    suffix, slen, row):
+        args = [self._buf, self._cache, self._pos, self._logits]
+        if self._spec_k > 0:
+            args += [self._dcache, self._dlogits]
+        else:
+            args += [None, None]
+        return tuple(args) + (entry_tokens, entry_cache, entry_dcache,
+                              np.int32(plen), suffix, np.int32(slen),
+                              np.int32(row))
+
+    def _step_args(self, active):
+        if self._spec_k > 0:
+            return (self._buf, self._cache, self._dcache, self._pos,
+                    self._logits, self._dlogits, active)
+        return (self._buf, self._cache, self._pos, self._logits, active,
+                self._rng)
+
     def warmup(self) -> "DecodeEngine":
-        """Compile the full program ladder (every bucket's join + the
-        step) against throwaway inputs, then reset the decode state —
-        after this, serving traffic never cold-compiles and any retrace
-        is a real bucketing bug (``jit.retraces`` stays 0).  Call before
-        ``start()`` (or at least before admitting traffic)."""
+        """Compile the full program ladder — every bucket's join, every
+        suffix bucket's warm join when the prefix cache is on, and the
+        (spec) step — against throwaway inputs, then reset the decode
+        state: after this, serving traffic never cold-compiles and any
+        retrace is a real bucketing bug (``jit.retraces`` stays 0).
+        Call before ``start()`` (or at least before admitting
+        traffic)."""
         import jax
 
-        state = (self._buf, self._cache, self._pos, self._logits)
+        last = None
         for bucket in self._buckets:
             prompt = np.zeros((1, bucket), np.int32)
-            # observed args must mirror _admit's exactly — a differing
-            # signature here would make the first real join a "retrace"
-            args = state + (prompt, np.int32(1), np.int32(0))
+            args = self._join_args(prompt, 1, 0)
             self._sentinel(f"join.l{bucket}").observe(args)
-            state = self._join_fn(bucket)(self._variables, *args)
+            last = self._join_fn(bucket)(self._variables,
+                                         self._draft_variables, *args)
+        if self._prefix is not None:
+            etoks = np.zeros((1, self._t), np.int32)
+            ecache = self._single_row_cache(self._cache)
+            edcache = self._single_row_cache(self._dcache) \
+                if self._spec_k > 0 else None
+            for bucket in self._buckets:
+                suffix = np.zeros((1, bucket), np.int32)
+                args = self._sjoin_args(etoks, ecache, edcache, 1,
+                                        suffix, 1, 0)
+                self._sentinel(f"sjoin.s{bucket}").observe(args)
+                last = self._sjoin_fn(bucket)(
+                    self._variables, self._draft_variables, *args)
         active = np.zeros((self._b,), bool)
-        args = state + (active, self._rng)
-        self._sentinel("step").observe(args)
-        out = self._build_step()(self._variables, *args)
-        jax.block_until_ready(out[0])
+        args = self._step_args(active)
+        name = "spec_step" if self._spec_k > 0 else "step"
+        self._sentinel(name).observe(args)
+        if self._spec_k > 0:
+            last = self._build_step()(self._variables,
+                                      self._draft_variables, *args)
+        else:
+            last = self._build_step()(self._variables, *args)
+        jax.block_until_ready(last[0])
         self._init_state()
         return self
 
@@ -420,6 +688,12 @@ class DecodeEngine:
         (online-learning semantics — a request is not a consistency
         domain here).
 
+        **The prefix cache is flushed**: cached KV is a pure function of
+        (tokens, weights), so every entry is stale under the promoted
+        checkpoint.  Flushed here AND again when the decode thread
+        adopts the tree — an admit racing between the two could insert
+        one more old-weight entry, and the adoption-time flush drops it.
+
         The tree is validated HERE, on the caller's thread: a promote
         that would change the compiled programs' signatures (structure /
         leaf shape / dtype — e.g. a wire-shipped tree for a different
@@ -445,6 +719,13 @@ class DecodeEngine:
                 f"promoted variables would re-trace the decode programs "
                 f"(leaf shape/dtype mismatch: {'; '.join(bad[:3])}"
                 f"{' ...' if len(bad) > 3 else ''})")
+        # flush BEFORE publishing: were the order reversed, the decode
+        # thread could adopt + flush + insert a valid NEW-weight entry
+        # in the window before this thread's flush, which would then
+        # drop it — old-weight entries inserted in the remaining window
+        # die at the adoption-time flush instead
+        if self._prefix is not None:
+            self._prefix.flush()
         with self._lock:
             self._pending_variables = new
             self._work.notify_all()
@@ -456,6 +737,12 @@ class DecodeEngine:
             self._pending_variables = None
         if new is not None:
             self._variables = new
+            if self._prefix is not None:
+                # close the promote()-to-adoption race: any entry a
+                # concurrent admit inserted under the OLD weights after
+                # the caller-side flush dies here, before the new
+                # weights serve a single token
+                self._prefix.flush()
 
     # -- admission ----------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None
@@ -505,8 +792,46 @@ class DecodeEngine:
     def _active_count(self) -> int:
         return sum(1 for s in self._slots if s.request is not None)
 
+    def _adopt_state(self, outs, capture: bool):
+        """Unpack a join program's outputs into the engine state and
+        return the captured prefix entry arrays (or None)."""
+        self._buf, self._cache, self._pos, self._logits = outs[:4]
+        n = 4
+        if self._spec_k > 0:
+            self._dcache, self._dlogits = outs[4:6]
+            n = 6
+        if not capture:
+            return None
+        etoks, ecache = outs[n], outs[n + 1]
+        edcache = outs[n + 2] if self._spec_k > 0 else None
+        return etoks, ecache, edcache
+
+    def _join_cold(self, req: ServeRequest, row: int):
+        bucket = self.config.bucket_for(req.length, self._t)
+        prompt = np.zeros((1, bucket), np.int32)
+        prompt[0, :req.length] = req.prompt
+        args = self._join_args(prompt, req.length, row)
+        self._sentinel(f"join.l{bucket}").observe(args)
+        outs = self._join_fn(bucket)(self._variables,
+                                     self._draft_variables, *args)
+        return self._adopt_state(outs, self._prefix is not None)
+
+    def _join_warm(self, req: ServeRequest, row: int,
+                   entry: PrefixEntry, plen: int):
+        s = req.length - plen
+        bucket = self.config.bucket_for(s, self._t)
+        suffix = np.zeros((1, bucket), np.int32)
+        suffix[0, :s] = req.prompt[plen:]
+        args = self._sjoin_args(entry.tokens, entry.cache,
+                                entry.draft_cache, plen, suffix, s, row)
+        self._sentinel(f"sjoin.s{bucket}").observe(args)
+        outs = self._sjoin_fn(bucket)(self._variables,
+                                      self._draft_variables, *args)
+        return self._adopt_state(outs, True)
+
     def _admit(self) -> int:
-        """Move queued requests into free slots (prefill + scatter).
+        """Move queued requests into free slots (prefill + scatter — or,
+        on a prefix-cache hit, a suffix re-play over the cached KV).
         Decode-thread only; the queue pop is the one locked touch."""
         admitted = 0
         while True:
@@ -520,18 +845,19 @@ class DecodeEngine:
                 self._g_queue.set(len(self._queue))
             req.admit_t = time.perf_counter()
             self._h_queue_wait.observe(req.admit_t - req.submit_t)
-            bucket = self.config.bucket_for(req.length, self._t)
-            prompt = np.zeros((1, bucket), np.int32)
-            prompt[0, :req.length] = req.prompt
             t0 = time.perf_counter()
-            self._sentinel(f"join.l{bucket}").observe(
-                (self._buf, self._cache, self._pos, self._logits, prompt,
-                 np.int32(req.length), np.int32(row)))
-            self._buf, self._cache, self._pos, self._logits = \
-                self._join_fn(bucket)(
-                    self._variables, self._buf, self._cache, self._pos,
-                    self._logits, prompt, np.int32(req.length),
-                    np.int32(row))
+            if self._prefix is not None:
+                hit = self._prefix.lookup(req.prompt)
+                if hit is not None:
+                    req.warm = True
+                    captured = self._join_warm(req, row, *hit)
+                else:
+                    req.warm = False
+                    captured = self._join_cold(req, row)
+                if captured is not None:
+                    self._prefix.insert(PrefixEntry(req.prompt, *captured))
+            else:
+                self._join_cold(req, row)
             self._h_join.observe(time.perf_counter() - t0)
             self._slots[row].request = req
             self._c_admitted.inc()
@@ -548,39 +874,103 @@ class DecodeEngine:
         self._h_e2e.observe(now - req.submit_t)
         req._done.set()
 
-    def _step_once(self) -> None:
+    def _dispatch_step(self) -> _Pending:
+        """Dispatch ONE device step (plain or speculative) and return
+        the pending handle — no host readback here; that happens in
+        ``_retire_step``, overlapped with the NEXT dispatched step."""
         active = np.array([s.request is not None for s in self._slots],
                           bool)
+        reqs = [s.request for s in self._slots]
         t0 = time.perf_counter()
-        self._sentinel("step").observe(
-            (self._buf, self._cache, self._pos, self._logits, active,
-             self._rng))
-        self._buf, self._cache, self._pos, self._logits, self._rng, nxt = \
-            self._build_step()(self._variables, self._buf, self._cache,
-                               self._pos, self._logits, active, self._rng)
-        tokens = np.asarray(nxt)       # the per-step host readback
-        now = time.perf_counter()
-        dt = now - t0
-        self._h_step.observe(dt)
-        self._c_steps.inc()
-        eos = self.config.eos_id
+        args = self._step_args(active)
+        if self._spec_k > 0:
+            self._sentinel("spec_step").observe(args)
+            (self._buf, self._cache, self._dcache, self._pos,
+             self._logits, self._dlogits, tokens, counts) = \
+                self._build_step()(self._variables,
+                                   self._draft_variables, *args)
+        else:
+            self._sentinel("step").observe(args)
+            (self._buf, self._cache, self._pos, self._logits, self._rng,
+             tokens) = self._build_step()(self._variables, *args)
+            counts = None
+        return _Pending(reqs, tokens, counts, t0)
+
+    def _drain_certain(self, pending: Optional[_Pending]) -> bool:
+        """True when the un-retired ``pending`` step is guaranteed to
+        retire EVERY currently-active row (each was in the pending
+        snapshot and needs at most the one token every step is
+        guaranteed to emit), so dispatching another step now would be
+        pure waste — its outputs discarded row-by-row by the snapshot
+        check.  Host-side knowledge only: eos can finish a row early
+        but never makes this True spuriously."""
+        if pending is None:
+            return False
         for row, slot in enumerate(self._slots):
             req = slot.request
             if req is None:
                 continue
-            tok = int(tokens[row])
-            req.tokens.append(tok)
-            self._c_tokens.inc()
-            self._h_per_token.observe(dt)
-            if req.first_token_t is None:
-                req.first_token_t = now
-                self._h_ttft.observe(now - req.submit_t)
-            if len(req.tokens) >= req.max_new or \
-                    (eos is not None and tok == int(eos)):
-                self._finish(row, now)
+            if pending.reqs[row] is not req or \
+                    len(req.tokens) + 1 < req.max_new:
+                return False
+        return True
+
+    def _retire_step(self, pending: _Pending) -> None:
+        """Host bookkeeping for a previously dispatched step: block on
+        its outputs, attribute tokens via the dispatch-time snapshot
+        (a row that retired or re-joined since the dispatch is skipped),
+        stamp SLOs, retire finished rows.
+
+        ``dt`` below is the step's dispatch->retire wall: one loop
+        iteration under dispatch-ahead, so it includes the overlapped
+        host work between the two points (see the module docstring) —
+        step cadence, not isolated device time."""
+        tokens = np.asarray(pending.tokens)    # the per-step readback
+        counts = None if pending.counts is None \
+            else np.asarray(pending.counts)
+        now = time.perf_counter()
+        dt = now - pending.t0
+        self._h_step.observe(dt)
+        self._c_steps.inc()
+        eos = self.config.eos_id
+        k = self._spec_k
+        for row, req in enumerate(pending.reqs):
+            if req is None or req.done:
+                continue
+            if counts is None:
+                emitted = [int(tokens[row])]
+            else:
+                emitted = [int(v) for v in tokens[row, :int(counts[row])]]
+                self._c_spec_proposed.inc(k)
+                self._c_spec_accepted.inc(int(counts[row]) - 1)
+            for tok in emitted:
+                req.tokens.append(tok)
+                self._c_tokens.inc()
+                self._h_per_token.observe(dt)
+                if req.first_token_t is None:
+                    req.first_token_t = now
+                    self._h_ttft.observe(now - req.submit_t)
+                    if req.warm is True:
+                        self._h_ttft_warm.observe(now - req.submit_t)
+                    elif req.warm is False:
+                        self._h_ttft_cold.observe(now - req.submit_t)
+                if len(req.tokens) >= req.max_new or \
+                        (eos is not None and tok == int(eos)):
+                    # tokens past the stop condition (possible inside a
+                    # speculative window) are discarded — the slot's
+                    # device state is replaced wholesale at re-join
+                    self._finish(row, now)
+                    break
+        if counts is not None:
+            prop = self._c_spec_proposed.value
+            if prop:
+                self._g_accept_rate.set(
+                    self._c_spec_accepted.value / prop)
         self._g_active.set(self._active_count())
+        self._h_host.observe(time.perf_counter() - now)
 
     def _loop(self) -> None:
+        pending: Optional[_Pending] = None
         try:
             while True:
                 # a hard stop (stop(drain=False)) exits immediately; the
@@ -593,10 +983,18 @@ class DecodeEngine:
                     return
                 self._adopt_promotion()
                 self._admit()
-                if self._active_count():
-                    # _idle_evt was cleared (under the lock) by the
-                    # submit() that queued this work
-                    self._step_once()
+                # dispatch-ahead: device step k+1 goes out BEFORE step
+                # k's host bookkeeping, so detokenize/retire/SLO work
+                # overlaps the in-flight device step.  Exception: when
+                # step k is certain to drain the whole batch, step k+1
+                # would be dispatched only to be discarded — skip it
+                nxt = self._dispatch_step() \
+                    if self._active_count() and \
+                    not self._drain_certain(pending) else None
+                if pending is not None:
+                    self._retire_step(pending)
+                pending = nxt
+                if pending is not None:
                     continue
                 with self._lock:
                     if self._queue:

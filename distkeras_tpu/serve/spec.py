@@ -1,0 +1,154 @@
+"""Speculative decoding — decode accelerator #2 (ISSUE 11).
+
+One-token-per-step decode leaves the target model memory-bound: every
+step reads the full parameter set to produce ONE token per row.  A small
+**draft** model (the ``gpt_lm`` family already scales down) proposes
+``k`` tokens per active row; the target then verifies all ``k`` in ONE
+batched ``decode_window`` — the accepted prefix ships ``m + 1`` tokens
+(the ``m`` matching proposals plus the target's own next token) for a
+single target-weight read plus one fix-up decode.
+
+Greedy-only, with provable parity: a proposal ``x_i`` is accepted iff it
+equals the target's own argmax given the previously accepted context, so
+every emitted token is exactly the token ``generate_tokens`` would have
+produced — at ANY draft quality.  A bad draft costs speed (low accept
+rate), never correctness.
+
+**Accepted-prefix rollback keeps the ragged KV cache exact** without
+copying anything back: the verify window writes K/V for all ``k``
+proposals, but a row's attention horizon is its own position, so K/V at
+positions past ``pos + m`` is never attended before the row's later
+decode *overwrites* it (the same placeholder contract as prefill
+padding).  Rolling back IS just not advancing ``pos``.
+
+The whole step — draft propose scan, target verify window, acceptance
+arithmetic, buffer scatter, target + draft fix-up decode — is one
+compiled program behind one retrace sentinel, so steady-state serving
+stays ``jit.retraces == 0``.
+
+Metrics (service registry, recorded by the engine): counters
+``serve.spec.proposed`` / ``serve.spec.accepted``, gauge
+``serve.spec.accept_rate`` (running ratio; ``obsview --serve`` renders a
+LOW-ACCEPT alarm when it collapses).
+"""
+
+from __future__ import annotations
+
+from ..models.generation import _model_cache, decode_window
+
+
+def validate_draft(model, draft_model, draft_variables, batch: int,
+                   spec_k: int) -> None:
+    """Config-time rejection (the ``max_queue=0`` precedent) for a draft
+    that cannot verify against this target: checked when the engine is
+    built, never discovered by the decode thread."""
+    if draft_model is None or draft_variables is None:
+        raise ValueError(
+            f"spec_k={spec_k} needs a draft model: pass draft_model= and "
+            f"draft_variables= to DecodeEngine (the gpt_lm family scales "
+            f"down to draft size)")
+    vocab = int(model.output_shape[-1])
+    dvocab = int(draft_model.output_shape[-1])
+    if dvocab != vocab:
+        raise ValueError(
+            f"draft checkpoint is not shape-compatible with the target: "
+            f"draft vocab {dvocab} != target vocab {vocab} (proposals "
+            f"are verified token-by-token in one shared id space)")
+    t = int(model.input_shape[0])
+    dt = int(draft_model.input_shape[0])
+    if dt != t:
+        raise ValueError(
+            f"draft seq_len {dt} != target seq_len {t}: the draft's KV "
+            f"cache tracks the same absolute positions as the target's")
+    if _model_cache(draft_model, batch) is None:
+        raise ValueError(
+            "the draft model does not support the KV-cached decode path "
+            "(init_cache protocol) — speculative proposal is a cached "
+            "decode scan")
+
+
+def build_spec_step(model, draft_model, spec_k: int):
+    """The compiled speculative step for ``DecodeEngine``.
+
+    Returns ``fn(variables, dvariables, buf, cache, dcache, pos, logits,
+    dlogits, active) -> (buf, cache, dcache, pos, logits, dlogits,
+    emitted, counts)`` where ``emitted`` is (B, k+1) int32 — row r's
+    tokens for positions ``pos_r .. pos_r + counts_r - 1`` — and
+    ``counts`` is (B,) int32 in [1, k+1] (valid only where ``active``).
+
+    Alignment invariant (matches the engine's carried state): ``logits``
+    / ``dlogits`` are each model's distribution for the token AT ``pos``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    k = int(spec_k)
+    t = int(model.input_shape[0])
+
+    def _spec_step(variables, dvariables, buf, cache, dcache, pos,
+                   logits, dlogits, active):
+        params, state = variables["params"], variables["state"]
+        dparams, dstate = dvariables["params"], dvariables["state"]
+        b = buf.shape[0]
+
+        # 1) draft proposes k tokens: x_i = argmax of its carried
+        # distribution, fed back at position pos + i (clamped like every
+        # possibly-overrunning write; see decode_window's contract)
+        def propose(carry, i):
+            dl, dc = carry
+            x = jnp.argmax(dl, axis=-1).astype(jnp.int32)
+            p = jnp.minimum(pos + i, t - 1)
+            dl2, dc = draft_model.layer.apply_decode(dparams, dstate, x,
+                                                     dc, p)
+            return (dl2, dc), x
+
+        (_, dcache), xs = lax.scan(propose, (dlogits, dcache),
+                                   jnp.arange(k))
+        proposals = jnp.moveaxis(xs, 0, 1)                  # (B, k)
+
+        # 2) target verifies all k proposals in one batched window
+        win, cache = decode_window(model.layer, params, state, proposals,
+                                   cache, pos, limit=t)     # (B, k, V)
+
+        # 3) acceptance: the target's own argmax chain.  targets[:, i]
+        # is the target token AT pos+i given proposals[:, :i] — valid
+        # exactly when those proposals were all accepted, which the
+        # cumulative product encodes.
+        y0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+        yw = jnp.argmax(win, axis=-1).astype(jnp.int32)     # (B, k)
+        targets = jnp.concatenate([y0, yw], axis=1)         # (B, k+1)
+        accepted = jnp.cumprod(
+            (proposals == targets[:, :k]).astype(jnp.int32), axis=1)
+        m = accepted.sum(axis=1)                            # (B,) in [0,k]
+        counts = m + 1
+
+        # 4) emit targets[:, :m+1] into the buffer at pos .. pos+m (a
+        # write past seq_len one-hots to the zero vector — dropped, the
+        # row is retiring anyway)
+        idx = jnp.arange(k + 1)[None, :]
+        keep = (idx <= m[:, None]) & active[:, None]        # (B, k+1)
+        w = jax.nn.one_hot(pos[:, None] + idx, t,
+                           dtype=jnp.int32) * keep[..., None].astype(
+                               jnp.int32)                   # (B, k+1, T)
+        buf = buf * (1 - w.sum(1)) + (targets[..., None] * w).sum(1)
+
+        # 5) fix-up decode of the LAST emitted token (the correction /
+        # bonus the draft never saw): gives the carried logits for
+        # pos+m+1 and overwrites the one wrong K/V slot a rejected
+        # proposal left at pos+m — both models stay exactly in sync
+        # with the emitted context
+        last = jnp.take_along_axis(targets, m[:, None], axis=1)[:, 0]
+        pfix = jnp.minimum(pos + m, t - 1)
+        l2, cache = model.layer.apply_decode(params, state, last, cache,
+                                             pfix)
+        logits = jnp.where(active[:, None], l2.astype(logits.dtype),
+                           logits)
+        dl2, dcache = draft_model.layer.apply_decode(dparams, dstate,
+                                                     last, dcache, pfix)
+        dlogits = jnp.where(active[:, None], dl2.astype(dlogits.dtype),
+                            dlogits)
+        pos = pos + counts * active.astype(jnp.int32)
+        return buf, cache, dcache, pos, logits, dlogits, targets, counts
+
+    return _spec_step

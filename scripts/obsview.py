@@ -552,13 +552,51 @@ def summarize_ps_fleet(replies: list) -> str:
     return "\n".join(lines)
 
 
-#: the serving SLO surface, rendered in this order (ISSUE 7)
+#: the serving SLO surface, rendered in this order (ISSUE 7; ISSUE 11
+#: adds the warm/cold ttft split and the dispatch-ahead host component)
 _SLO_HISTS = (("serve.queue_wait_seconds", "queue wait"),
               ("serve.ttft_seconds", "first token"),
+              ("serve.ttft_warm_seconds", "  ttft (warm)"),
+              ("serve.ttft_cold_seconds", "  ttft (cold)"),
               ("serve.per_token_seconds", "per token"),
               ("serve.e2e_seconds", "end-to-end"),
               ("serve.step_seconds", "batch step"),
+              ("serve.host_seconds", "  host (hidden)"),
               ("serve.join_seconds", "join (prefill)"))
+
+#: draft accept rate below this (with proposals flowing) renders the
+#: LOW-ACCEPT alarm: the draft has diverged from the target and the
+#: speculative speedup is gone (correctness never depends on it)
+_LOW_ACCEPT = 0.25
+
+
+def _accel_lines(stats: dict) -> list:
+    """The ISSUE 11 accelerator panel: prefix-cache hit rate + LRU
+    level, draft accept rate + the LOW-ACCEPT alarm.  Metrics are
+    pre-created by the engine, so zeros mean 'enabled but idle / off' —
+    never 'missing'."""
+
+    def _v(name):
+        return stats.get(name, {}).get("value", 0)
+
+    lines = []
+    hits, misses = _v("serve.prefix.hits"), _v("serve.prefix.misses")
+    looked = hits + misses
+    lines.append(
+        f"prefix cache: hits {hits:,.0f}  misses {misses:,.0f}"
+        + (f"  (hit rate {hits / looked:.0%})" if looked else "")
+        + f"  entries {_v('serve.prefix.entries'):,.0f}"
+          f"  bytes {_v('serve.prefix.bytes'):,.0f}"
+          f"  evictions {_v('serve.prefix.evictions'):,.0f}")
+    proposed = _v("serve.spec.proposed")
+    rate = _v("serve.spec.accept_rate")
+    lines.append(
+        f"spec decode: proposed {proposed:,.0f}  accepted "
+        f"{_v('serve.spec.accepted'):,.0f}  accept rate {rate:.0%}"
+        + ("  << LOW-ACCEPT (draft diverged from target; speculative "
+           "speedup lost)"
+           if proposed and rate < _LOW_ACCEPT else ""))
+    return lines
 
 
 def summarize_serve(reply: dict) -> str:
@@ -604,6 +642,8 @@ def summarize_serve(reply: dict) -> str:
                  f"{retraces:,.0f}"
                  + ("  << RETRACING (bucket instability)"
                     if retraces else ""))
+    lines += ["", "== Accelerators =="]
+    lines.extend(_accel_lines(stats))
     lines += ["", "== Instruments =="]
     lines.extend(_instrument_lines(stats))
     return "\n".join(lines)

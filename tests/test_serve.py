@@ -4,7 +4,14 @@ load shedding, draining rejections, hard-stop aborts — nothing drops
 without a recorded rejection), the serve wire (v1<->v2 interop over the
 shared hello seam), the steady-state ``jit.retraces == 0`` contract
 drift-gated by the committed ``OBS_BASELINE.json``, ``bench.py --serve``
-and the ``obsview --serve`` rendering."""
+and the ``obsview --serve`` rendering.
+
+ISSUE 11 adds the decode accelerators: prefix-KV-cache warm joins
+(parity, ttft split, LRU eviction under budget pressure, the
+``promote()`` flush) and speculative decoding (greedy parity vs
+``generate_tokens`` across bucket boundaries and eos-mid-window, at any
+draft quality), their config-time knob validation, and their bench /
+obsview surfaces."""
 
 import copy
 import importlib.util
@@ -161,6 +168,305 @@ def test_checkpoint_promotion_swaps_weights_without_retrace(lm):
         "distinct checkpoints should decode differently"
     assert reg.counter("serve.promotions").value == 1
     assert reg.counter("jit.retraces").value == 0
+
+
+# ---------------------------------------------------------------------------
+# prefix KV cache (ISSUE 11 accelerator #1)
+# ---------------------------------------------------------------------------
+
+def test_config_accelerator_knob_validation(lm):
+    """The new knobs reject at CONFIG time (the max_queue=0 precedent):
+    an unbounded device cache, a nonsense block/k, sampling under
+    speculative decode, and a draft the target cannot verify against are
+    all caller errors, never decode-thread discoveries."""
+    model, v = lm
+    with pytest.raises(ValueError):
+        ServeConfig(prefix_cache=True, prefix_cache_mb=0.0)
+    with pytest.raises(ValueError):
+        ServeConfig(prefix_cache=True, prefix_cache_mb=-1.0)
+    with pytest.raises(ValueError):
+        ServeConfig(prefix_block=0)
+    with pytest.raises(ValueError):
+        ServeConfig(spec_k=-1)
+    with pytest.raises(ValueError):  # greedy-only: no speculative sampling
+        ServeConfig(spec_k=2, temperature=0.7)
+    # draft validation happens at ENGINE construction, same contract
+    cfg = ServeConfig(spec_k=2, max_new_tokens=12)
+    with pytest.raises(ValueError, match="draft"):
+        DecodeEngine(model, v, cfg, registry=Registry())
+    wrong_vocab = zoo.gpt_lm(vocab_size=VOCAB * 2, dim=8, num_heads=2,
+                             num_blocks=1, seq_len=SEQ)
+    with pytest.raises(ValueError, match="vocab"):
+        DecodeEngine(model, v, cfg, registry=Registry(),
+                     draft_model=wrong_vocab,
+                     draft_variables=wrong_vocab.init(0))
+    wrong_seq = zoo.gpt_lm(vocab_size=VOCAB, dim=8, num_heads=2,
+                           num_blocks=1, seq_len=SEQ * 2)
+    with pytest.raises(ValueError, match="seq_len"):
+        DecodeEngine(model, v, cfg, registry=Registry(),
+                     draft_model=wrong_seq,
+                     draft_variables=wrong_seq.init(0))
+    # zoo.draft_lm builds the compatible shape by construction
+    draft = zoo.draft_lm(model, dim=8)
+    assert int(draft.output_shape[-1]) == VOCAB
+    assert int(draft.input_shape[0]) == SEQ
+    # the converse mistake: a draft supplied with spec_k == 0 would
+    # silently never speculate — rejected at construction too
+    with pytest.raises(ValueError, match="spec_k"):
+        DecodeEngine(model, v, ServeConfig(max_new_tokens=12),
+                     registry=Registry(),
+                     draft_model=draft, draft_variables=draft.init(0))
+
+
+def test_prefix_cache_warm_join_parity_and_ttft_split(lm):
+    """Prompts sharing a block-aligned system prefix warm-join over the
+    cached KV: the decoded output is EXACTLY the cold path's (the
+    offline reference), the hit/miss counters and the warm/cold ttft
+    split record the outcome, and the pre-compiled suffix-join ladder
+    holds ``jit.retraces == 0``."""
+    rng = np.random.default_rng(20)
+    reg = Registry()
+    eng = _engine(lm, registry=reg, prefill_buckets=(8, SEQ),
+                  prefix_cache=True, prefix_cache_mb=8.0,
+                  prefix_block=8).warmup()
+    snap0 = reg.snapshot()
+    # full ladder: 2 joins + 2 suffix joins + 1 step
+    assert snap0["jit.compiles"]["value"] == 5
+    shared = _prompt(rng, 8)  # one block exactly
+    prompts = [np.concatenate([shared, _prompt(rng, n)])
+               for n in (3, 5, 9)]  # suffixes span both buckets
+    with eng:
+        for p in prompts:
+            got = eng.submit(p, 6).result(timeout=60)
+            assert np.array_equal(got, _ref(lm, p, 6))
+        # resubmission of a fully cached prompt: longest-prefix match is
+        # capped at len-1, the last token re-plays, output identical
+        got = eng.submit(prompts[0], 6).result(timeout=60)
+        assert np.array_equal(got, _ref(lm, prompts[0], 6))
+    snap = reg.snapshot()
+    assert snap["serve.prefix.misses"]["value"] == 1
+    assert snap["serve.prefix.hits"]["value"] == 3
+    # 3 distinct prompts inserted; the resubmission dedups by content
+    assert snap["serve.prefix.inserts"]["value"] == 3
+    assert snap["serve.ttft_cold_seconds"]["count"] == 1
+    assert snap["serve.ttft_warm_seconds"]["count"] == 3
+    assert snap["jit.compiles"]["value"] == 5  # nothing new compiled
+    assert snap["jit.retraces"]["value"] == 0
+
+
+def test_prefix_cache_lru_eviction_under_pressure(lm):
+    """Fill the cache past its byte budget: LRU entries evict (recorded
+    under ``serve.prefix.evictions``, bytes bounded by the budget) and
+    every served output is unchanged — the cache only ever buys ttft,
+    never correctness."""
+    rng = np.random.default_rng(21)
+    reg = Registry()
+    budget_mb = 0.02  # a couple of entries' worth for this toy model
+    eng = _engine(lm, registry=reg, prefix_cache=True,
+                  prefix_cache_mb=budget_mb, prefix_block=8).warmup()
+    prompts = [_prompt(rng, 10) for _ in range(6)]  # all distinct
+    with eng:
+        for p in prompts:
+            got = eng.submit(p, 5).result(timeout=60)
+            assert np.array_equal(got, _ref(lm, p, 5))
+    snap = reg.snapshot()
+    assert snap["serve.prefix.inserts"]["value"] == 6
+    assert snap["serve.prefix.evictions"]["value"] >= 1
+    assert snap["serve.prefix.bytes"]["value"] <= budget_mb * 1024 * 1024
+    assert snap["serve.prefix.entries"]["value"] < 6
+    assert snap["jit.retraces"]["value"] == 0
+
+
+def test_prefix_eviction_repoints_shared_alias():
+    """First-writer-wins aliasing survives eviction of the owner: when
+    the entry that owns a shared-prefix lookup key is LRU-evicted while
+    another live entry still holds those prefix bytes, the alias is
+    re-pointed at the heir instead of dropped — the next prompt with
+    that prefix still warm-hits."""
+    from distkeras_tpu.serve.prefix import PrefixCache, PrefixEntry
+
+    def entry(host):
+        return PrefixEntry(np.asarray(host, np.int32),
+                           np.zeros((1, SEQ), np.int32),
+                           {"k": np.zeros((SEQ, 4), np.float32)})
+
+    rng = np.random.default_rng(23)
+    system = _prompt(rng, 8)  # exactly one block
+    a = entry(np.concatenate([system, _prompt(rng, 3)]))
+    b = entry(np.concatenate([system, _prompt(rng, 1)]))
+    c = entry(_prompt(rng, 10))  # unrelated content
+    reg = Registry()
+    cache = PrefixCache(a.nbytes + b.nbytes + c.nbytes - 1, reg, block=8)
+    cache.insert(a)  # first writer: owns the (8, sha1(system)) alias
+    cache.insert(b)
+    cache.insert(c)  # over budget -> evicts A (LRU)
+    snap = reg.snapshot()
+    assert snap["serve.prefix.evictions"]["value"] == 1
+    assert len(cache) == 2
+    hit = cache.lookup(np.concatenate([system, _prompt(rng, 2)]))
+    assert hit is not None
+    heir, matched = hit
+    assert matched == 8
+    assert np.array_equal(heir.host_tokens, b.host_tokens)
+    assert reg.snapshot()["serve.prefix.hits"]["value"] == 1
+
+
+def test_prefix_insert_of_covered_content_spends_no_budget():
+    """Inserting content every lookup key of which is already owned (a
+    block-aligned prompt fully covered by an older entry) must NOT
+    store an unreachable duplicate: the covering owner is LRU-refreshed
+    and no bytes/insert are accounted — budget is never spent on KV
+    that could never be hit."""
+    from distkeras_tpu.serve.prefix import PrefixCache, PrefixEntry
+
+    def entry(host):
+        return PrefixEntry(np.asarray(host, np.int32),
+                           np.zeros((1, SEQ), np.int32),
+                           {"k": np.zeros((SEQ, 4), np.float32)})
+
+    rng = np.random.default_rng(25)
+    system = _prompt(rng, 8)  # exactly one block
+    a = entry(np.concatenate([system, _prompt(rng, 8)]))  # owns (8,) (16,)
+    b = entry(system)  # fully covered: its only key (8,) is A's
+    reg = Registry()
+    cache = PrefixCache(10 * a.nbytes, reg, block=8)
+    cache.insert(a)
+    cache.insert(b)
+    snap = reg.snapshot()
+    assert len(cache) == 1
+    assert cache.nbytes == a.nbytes
+    assert snap["serve.prefix.inserts"]["value"] == 1
+    hit = cache.lookup(np.concatenate([system, _prompt(rng, 2)]))
+    assert hit is not None and hit[1] == 8
+    assert np.array_equal(hit[0].host_tokens, a.host_tokens)
+
+
+def test_drain_skips_wasted_lookahead_step(lm):
+    """Dispatch-ahead skips the look-ahead step when the in-flight one
+    is certain to drain the batch: a lone greedy request needing
+    ``max_new`` tokens costs EXACTLY ``max_new`` device steps — no
+    trailing step dispatched only to be discarded — and the output is
+    still the offline reference."""
+    rng = np.random.default_rng(24)
+    reg = Registry()
+    prompt = _prompt(rng, 7)
+    with _engine(lm, registry=reg) as eng:
+        got = eng.submit(prompt, 6).result(timeout=60)
+    assert np.array_equal(got, _ref(lm, prompt, 6))
+    snap = reg.snapshot()
+    assert snap["serve.steps"]["value"] == 6
+    assert snap["serve.tokens_out"]["value"] == 6
+
+
+def test_promote_flushes_prefix_cache(lm):
+    """A promoted checkpoint MUST flush the cache: cached KV is a pure
+    function of (tokens, weights).  A prompt cached under the old
+    weights decodes correctly under the new ones — served output equals
+    the offline decode under the deployed checkpoint."""
+    model, _ = lm
+    v_new = model.init(42)
+    rng = np.random.default_rng(22)
+    prompt = _prompt(rng, 9)
+    reg = Registry()
+    with _engine(lm, registry=reg, prefix_cache=True,
+                 prefix_cache_mb=8.0, prefix_block=4) as eng:
+        before = eng.submit(prompt, 6).result(timeout=60)
+        assert len(eng._prefix) == 1
+        eng.promote(v_new)
+        assert len(eng._prefix) == 0  # flushed with the swap
+        # the SAME prompt again: no stale-KV hit is possible, and the
+        # decode matches the offline reference under the NEW weights
+        after = eng.submit(prompt, 6).result(timeout=60)
+    assert np.array_equal(before, _ref(lm, prompt, 6))
+    ref_new = np.asarray(generate_tokens(
+        model, v_new, prompt[None, :], 6))[0, len(prompt):]
+    assert np.array_equal(after, ref_new)
+    assert reg.counter("jit.retraces").value == 0
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding (ISSUE 11 accelerator #2)
+# ---------------------------------------------------------------------------
+
+def _spec_engine(lm, registry, draft, draft_v, **kw):
+    model, v = lm
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_queue", 8)
+    kw.setdefault("max_new_tokens", 12)
+    return DecodeEngine(model, v, ServeConfig(**kw), registry=registry,
+                        draft_model=draft, draft_variables=draft_v)
+
+
+def test_spec_greedy_parity_across_buckets(lm):
+    """Speculative greedy output equals ``generate_tokens`` exactly, at
+    BOTH ends of draft quality: a self-draft (accept rate 1 — every
+    window fully accepted) and an independent random draft (accept rate
+    ~0 — every window rejected at its first token).  Prompts span the
+    bucket ladder; the whole run holds ``jit.retraces == 0``."""
+    model, v = lm
+    rng = np.random.default_rng(23)
+    prompts = [_prompt(rng, n) for n in (3, 8, 17)]  # both buckets
+    indep = zoo.draft_lm(model, dim=8, num_heads=2, num_blocks=1)
+    for draft, draft_v, lo, hi in ((model, v, 0.99, 1.0),
+                                   (indep, indep.init(7), 0.0, 0.5)):
+        reg = Registry()
+        eng = _spec_engine(lm, reg, draft, draft_v, spec_k=3,
+                           prefill_buckets=(8, SEQ)).warmup()
+        with eng:
+            for p in prompts:
+                got = eng.submit(p, 10).result(timeout=60)
+                assert np.array_equal(got, _ref(lm, p, 10))
+        snap = reg.snapshot()
+        rate = snap["serve.spec.accept_rate"]["value"]
+        assert lo <= rate <= hi, \
+            f"accept rate {rate} outside [{lo}, {hi}]"
+        assert snap["serve.spec.proposed"]["value"] > 0
+        assert snap["jit.retraces"]["value"] == 0
+
+
+def test_spec_eos_mid_window_stops_exactly(lm):
+    """An eos sampled MID speculative window (the self-draft guarantees
+    the window runs past it) stops the request exactly there, inclusive
+    — tokens the window emitted past the stop are discarded."""
+    model, v = lm
+    prompt = full = eos = None
+    for seed in range(16):
+        rng = np.random.default_rng(seed)
+        prompt = _prompt(rng, 5)
+        full = _ref(lm, prompt, 8)
+        eos = int(full[1])  # 2nd token: inside the first k=3 window
+        if eos != int(full[0]):
+            break
+    else:
+        pytest.skip("every probed continuation repeats its 2nd token")
+    reg = Registry()
+    eng = _spec_engine(lm, reg, model, v, spec_k=3, eos_id=eos).warmup()
+    with eng:
+        got = eng.submit(prompt, 8).result(timeout=60)
+    assert list(got) == list(full[:2])
+    assert reg.snapshot()["jit.retraces"]["value"] == 0
+
+
+def test_spec_composes_with_prefix_cache(lm):
+    """Both accelerators on one engine: a warm suffix join must prefill
+    the DRAFT's cache alongside the target's, and the speculative decode
+    that follows stays greedy-exact."""
+    model, v = lm
+    rng = np.random.default_rng(24)
+    shared = _prompt(rng, 8)
+    prompts = [np.concatenate([shared, _prompt(rng, n)]) for n in (3, 4)]
+    reg = Registry()
+    eng = _spec_engine(lm, reg, model, v, spec_k=2,
+                       prefill_buckets=(8, SEQ), prefix_cache=True,
+                       prefix_cache_mb=8.0, prefix_block=8).warmup()
+    with eng:
+        for p in prompts:
+            got = eng.submit(p, 8).result(timeout=60)
+            assert np.array_equal(got, _ref(lm, p, 8))
+    snap = reg.snapshot()
+    assert snap["serve.prefix.hits"]["value"] == 1
+    assert snap["serve.spec.accept_rate"]["value"] > 0.99
+    assert snap["jit.retraces"]["value"] == 0
 
 
 # ---------------------------------------------------------------------------
@@ -424,15 +730,30 @@ def test_bench_serve_emits_row_and_self_checks(tmp_path, monkeypatch):
     monkeypatch.setattr(
         bench, "_baseline_snapshot_path",
         lambda cfg, key, default: str(tmp_path / default))
+    # shrink both accelerator phases to this test's toy scale (the
+    # committed SERVE_*_PHASE defaults are sized for real prefill cost)
     kw = dict(requests=6, concurrency=2, prompt_len=5, max_new=4,
               slots=2, queue=4, out_dir=str(tmp_path), vocab=VOCAB,
-              dim=16, heads=2, blocks=1, seq_len=SEQ)
+              dim=16, heads=2, blocks=1, seq_len=SEQ,
+              prefix_phase=dict(requests=3, vocab=VOCAB, dim=16, heads=2,
+                                blocks=1, seq_len=SEQ, shared=16, tail=3,
+                                max_new=2, suffix_bucket=8, cache_mb=8.0,
+                                block=8),
+              spec_phase=dict(k=2, requests=3, prompt_len=4, max_new=6,
+                              vocab=VOCAB, dim=16, heads=2, blocks=1,
+                              seq_len=SEQ))
     row = bench.bench_serve(**kw)
     assert row["mode"] == "bench_serve"
     assert row["rejected"] == 0  # closed loop under capacity never sheds
     assert row["jit_retraces"] == 0
     assert row["e2e_ms_p50"] > 0 and row["ttft_ms_p50"] > 0
     assert row["tokens_per_sec"] > 0
+    # accelerator-phase rows are PRESENT (the pre-created contract)
+    assert row["prefix_hit_rate"] == round(2 / 3, 3)
+    assert row["ttft_warm_ms_p50"] > 0 and row["ttft_cold_ms_p50"] > 0
+    assert row["spec_k"] == 2 and row["spec_parity"] is True
+    assert row["spec_accept_rate"] == 1.0  # self-draft ceiling
+    assert row["tokens_per_sec_spec"] > 0
     assert row["obs_drift"] == {"checked": False,
                                 "reason": "no baseline snapshot"}
     snap_path = tmp_path / "BENCH_SERVE_OBS.json"
@@ -445,29 +766,65 @@ def test_bench_serve_emits_row_and_self_checks(tmp_path, monkeypatch):
     assert doc["server"]["jit.compiles"]["value"] > 0
     assert doc["server"]["serve.completed"]["value"] == 6
     assert doc["client"]["serve.client.requests"]["value"] == 6
+    # the load-phase engine runs with the cache off: its accelerator
+    # counters are present zeros, never missing
+    assert doc["server"]["serve.prefix.hits"]["value"] == 0
+    assert doc["server"]["serve.spec.proposed"]["value"] == 0
+    # the phase registries ride in the same drift-gated document
+    assert doc["prefix"]["serve.prefix.hits"]["value"] == 2
+    assert doc["prefix"]["serve.ttft_warm_seconds"]["count"] == 2
+    assert doc["spec"]["serve.spec.accept_rate"]["value"] == 1.0
+    assert doc["spec_base"]["serve.spec.proposed"]["value"] == 0
+    assert doc["row"]["spec_parity"] is True
 
     row2 = bench.bench_serve(**kw)
     assert row2["obs_drift"]["checked"] is True
+
+    # phases off: row keys still present, explicitly None
+    row3 = bench.bench_serve(**{**kw, "prefix_phase": False,
+                                "spec_phase": False})
+    assert row3["prefix_hit_rate"] is None
+    assert row3["spec_uplift"] is None
 
 
 def test_committed_serve_snapshot_matches_baseline_contract():
     """The committed BENCH_SERVE_OBS.json is a valid registry-snapshot
     document with the sentinels present at zero retraces — the state the
-    drift gate protects."""
+    drift gate protects.  ISSUE 11: the committed artifact also carries
+    both accelerator phases, and the acceptance numbers hold — warm ttft
+    p50 at least 3x lower than cold, and a tokens/sec uplift from
+    speculative decoding at exact greedy parity."""
     path = os.path.join(_ROOT, "BENCH_SERVE_OBS.json")
     assert os.path.exists(path), "bench.py --serve snapshot not committed"
     with open(path) as f:
         doc = json.load(f)
     assert doc["config"]["mode"] == "bench_serve"
-    for part in ("client", "server"):
-        assert drift.is_registry_snapshot(doc[part])
+    for part in ("client", "server", "prefix", "spec_base", "spec"):
+        assert drift.is_registry_snapshot(doc[part]), part
     assert doc["server"]["jit.retraces"]["value"] == 0
     for name in ("serve.e2e_seconds", "serve.ttft_seconds",
                  "serve.queue_wait_seconds", "serve.per_token_seconds"):
         assert doc["server"][name]["count"] > 0
+    # prefix phase: a real warm/cold split, zero retraces, >= 3x ttft win
+    assert doc["prefix"]["jit.retraces"]["value"] == 0
+    assert doc["prefix"]["serve.ttft_cold_seconds"]["count"] >= 1
+    assert doc["prefix"]["serve.ttft_warm_seconds"]["count"] >= 2
+    assert doc["prefix"]["serve.prefix.hits"]["value"] >= 2
+    assert doc["prefix"]["serve.prefix.evictions"]["value"] == 0
+    assert doc["row"]["warm_speedup"] >= 3.0
+    # spec phase: uplift at full acceptance and exact parity
+    assert doc["spec"]["jit.retraces"]["value"] == 0
+    assert doc["spec"]["serve.spec.proposed"]["value"] > 0
+    assert doc["spec"]["serve.spec.accept_rate"]["value"] == 1.0
+    assert doc["row"]["spec_parity"] is True
+    assert doc["row"]["spec_uplift"] > 1.0
     with open(os.path.join(_ROOT, "OBS_BASELINE.json")) as f:
         bl = json.load(f)
     assert bl["snapshots"]["serve_bench"] == "BENCH_SERVE_OBS.json"
+    # the accelerator gates the CI satellite names: exact prefix
+    # counters, the opted-in accept-rate gauge
+    assert bl["metrics"]["serve.prefix.*"]["counter_abs"] == 0.0
+    assert bl["metrics"]["serve.spec.accept_rate"]["gauge_abs"] <= 0.2
 
 
 def _load_obsview():
@@ -489,7 +846,38 @@ def test_obsview_serve_poll_renders_slo_table(lm):
     assert "first token" in out and "end-to-end" in out
     assert "retraces 0" in out
     assert "RETRACING" not in out
+    # the accelerator panel renders from the pre-created zeros
+    assert "prefix cache" in out and "spec decode" in out
+    assert "LOW-ACCEPT" not in out  # no proposals -> no alarm
     # the alarm renders when the sentinel fired
     reply = {"stats": {"jit.retraces": {"type": "counter", "value": 2},
                        "jit.compiles": {"type": "counter", "value": 3}}}
     assert "RETRACING" in obsview.summarize_serve(reply)
+
+
+def test_obsview_serve_accelerator_columns_and_low_accept_alarm():
+    """The ISSUE 11 panel: prefix hit-rate and draft accept-rate render
+    from a stats reply, and a collapsed accept rate (proposals flowing,
+    almost none accepted) raises the LOW-ACCEPT alarm — a healthy rate
+    must not."""
+    obsview = _load_obsview()
+
+    def reply(rate):
+        return {"stats": {
+            "serve.prefix.hits": {"type": "counter", "value": 30},
+            "serve.prefix.misses": {"type": "counter", "value": 10},
+            "serve.prefix.entries": {"type": "gauge", "value": 4},
+            "serve.prefix.bytes": {"type": "gauge", "value": 4096},
+            "serve.prefix.evictions": {"type": "counter", "value": 2},
+            "serve.spec.proposed": {"type": "counter", "value": 300},
+            "serve.spec.accepted": {"type": "counter",
+                                    "value": int(300 * rate)},
+            "serve.spec.accept_rate": {"type": "gauge", "value": rate},
+        }}
+
+    healthy = obsview.summarize_serve(reply(0.8))
+    assert "hit rate 75%" in healthy
+    assert "accept rate 80%" in healthy
+    assert "LOW-ACCEPT" not in healthy
+    collapsed = obsview.summarize_serve(reply(0.05))
+    assert "LOW-ACCEPT" in collapsed
