@@ -713,7 +713,9 @@ def bench_continual(intervals: int = 16, snapshot_every: int = 4,
 def bench_ps(codec: str = "none", windows: int = 50, mb: float = 4.0,
              out_dir: str = ROOT, wire_version=None,
              ps_workers: int = 1, ps_shards: int = 1,
-             ps_shard_placement: str = "threads") -> dict:
+             ps_shard_placement: str = "threads",
+             down: str = "none", pull_ratio: int = 1,
+             shm: bool = False) -> dict:
     """PS-comms microbenchmark (ISSUE 4 acceptance): N pull+commit windows
     against a localhost PS over an ``mb``-megabyte synthetic center, from
     ``ps_workers`` concurrent clients (ISSUE 5: the contention sweep point
@@ -723,28 +725,45 @@ def bench_ps(codec: str = "none", windows: int = 50, mb: float = 4.0,
     the sweep that shows whether sharding flattens the single-lock
     commit-RTT pileup.
 
-    Returns (and the CLI prints) one JSON row: median/p99 commit RTT
-    across all workers, wire bytes per window, compression ratio.  One
-    MERGED registry snapshot per sweep point is written beside the
-    BENCH_r*.json files — ``BENCH_PS_OBS.json`` for the single-worker
-    point (the committed baseline), ``BENCH_PS_OBS_w<N>.json`` for
-    contention points (self-checked when ``OBS_BASELINE.json`` maps a
-    ``ps_bench_w<N>`` snapshot) — all in the same document schema obsview
-    and the drift gate read.
+    ISSUE 12 (wire round 2): ``down`` selects the DOWN pull-compression
+    spec ("int8"/"bf16"/"topk<frac>"/"adaptive"), ``pull_ratio`` makes
+    each window **pull-heavy** — ``pull_ratio`` timed FRESH pulls (the
+    client cache is invalidated per pull so every one ships a center,
+    the regime a busy async fleet's pulls are in) per commit — and
+    ``shm=True`` negotiates the same-host shared-memory transport.  Pull
+    RTTs land in their own ``bench.ps.pull_seconds`` histogram (committed
+    evidence for the shm-vs-TCP comparison), and the row carries
+    DOWN-direction bytes/window plus the reference-residual compression
+    ratio.
+
+    Returns (and the CLI prints) one JSON row: median/p99 commit AND pull
+    RTT across all workers, wire bytes per window (direction-tagged),
+    compression ratios.  One MERGED registry snapshot per sweep point is
+    written beside the BENCH_r*.json files — ``BENCH_PS_OBS.json`` for
+    the single-worker point (the committed baseline),
+    ``BENCH_PS_OBS_shm.json`` for the single-worker shm point, and
+    ``BENCH_PS_OBS_w<N>.json`` for contention points (self-checked when
+    ``OBS_BASELINE.json`` maps a ``ps_bench_w<N>`` / ``ps_bench_shm``
+    snapshot) — all in the same document schema obsview and the drift
+    gate read.
     """
-    from distkeras_tpu.obs import Registry
+    from distkeras_tpu.obs import Registry, TIME_BUCKETS
     from distkeras_tpu.ps import (PSClient, ShardedParameterServer,
                                   ShardedPSClient, SocketParameterServer)
     from distkeras_tpu.ps.servers import DeltaParameterServer
     from distkeras_tpu.ps.shard.server import ProcessShardFleet
 
+    from distkeras_tpu.ps.codecs import validate_down_spec
+
     ps_workers = int(ps_workers)
     windows = int(windows)
     ps_shards = int(ps_shards)
-    if ps_workers < 1 or windows < 1 or ps_shards < 1:
-        raise ValueError(f"bench_ps needs ps_workers, windows and "
-                         f"ps_shards >= 1 (got {ps_workers}, {windows}, "
-                         f"{ps_shards})")
+    pull_ratio = int(pull_ratio)
+    down = validate_down_spec(down)
+    if ps_workers < 1 or windows < 1 or ps_shards < 1 or pull_ratio < 1:
+        raise ValueError(f"bench_ps needs ps_workers, windows, ps_shards "
+                         f"and pull_ratio >= 1 (got {ps_workers}, "
+                         f"{windows}, {ps_shards}, {pull_ratio})")
     if ps_shard_placement not in ("threads", "processes"):
         raise ValueError(f"ps_shard_placement must be 'threads' or "
                          f"'processes', got {ps_shard_placement!r}")
@@ -774,33 +793,84 @@ def bench_ps(codec: str = "none", windows: int = 50, mb: float = 4.0,
         ps = DeltaParameterServer(center, num_workers=ps_workers)
     regs = [Registry() for _ in range(ps_workers)]  # one per client thread
     rtts = [[] for _ in range(ps_workers)]
+    pull_rtts = [[] for _ in range(ps_workers)]
+    tcp_pull_rtts = [[] for _ in range(ps_workers)]
     wire_bytes = [0.0] * ps_workers
+    down_bytes = [0.0] * ps_workers
+    shm_active = [False] * ps_workers
     negotiated = [1] * ps_workers
     errors: list = []
 
-    def make_client(k: int):
+    def make_client(k: int, use_shm: bool):
+        # explicit bool: False must DISABLE shm even under DKTPU_SHM=1,
+        # or the TCP reference phase of an --shm A/B silently negotiates
+        # rings and measures shm against itself
         if sharded is not None:
             return ShardedPSClient(sharded.addrs(), center, k,
                                    registry=regs[k], codec=codec,
-                                   wire_version=wire_version)
+                                   wire_version=wire_version, down=down,
+                                   shm=use_shm)
         return PSClient("127.0.0.1", server.port, k, registry=regs[k],
-                        codec=codec, wire_version=wire_version)
+                        codec=codec, wire_version=wire_version, down=down,
+                        shm=use_shm)
 
     def drive(k: int) -> None:
         try:
             creg = regs[k]
-            with make_client(k) as client:
+            # dedicated pull/commit RTT histograms ride the committed
+            # snapshot — the shm-vs-TCP pull-p50 comparison's evidence
+            h_pull = creg.histogram("bench.ps.pull_seconds", TIME_BUCKETS)
+            h_commit = creg.histogram("bench.ps.commit_seconds",
+                                      TIME_BUCKETS)
+            if shm:
+                # A/B reference phase (ISSUE 12): the SAME pull-heavy
+                # workload over plain TCP first, into its own histogram,
+                # so ONE committed snapshot carries both sides of the
+                # shm-vs-TCP-loopback comparison
+                h_tcp = creg.histogram("bench.ps.pull_seconds_tcp",
+                                       TIME_BUCKETS)
+                with make_client(k, use_shm=False) as ref:
+                    ref.pull()  # connection + first center transfer warm
+                    for _ in range(windows * pull_ratio):
+                        ref.invalidate()
+                        t0 = time.perf_counter()
+                        ref.pull()
+                        dt = time.perf_counter() - t0
+                        tcp_pull_rtts[k].append(dt)
+                        h_tcp.observe(dt)
+            with make_client(k, use_shm=shm) as client:
                 negotiated[k] = client.wire_version
                 client.pull()  # connection + first center transfer warm
                 b0 = creg.counter("net.bytes_sent").value \
                     + creg.counter("net.bytes_recv").value
+                d0 = creg.counter("ps.wire.bytes_down").value
                 for _ in range(windows):
-                    client.pull()
+                    # pull-heavy window (ISSUE 12): ``pull_ratio`` fresh
+                    # pulls per commit — each invalidated so a center
+                    # actually ships, the regime a busy fleet's pulls
+                    # are in (some OTHER worker committed since)
+                    for _ in range(pull_ratio):
+                        client.invalidate()
+                        t0 = time.perf_counter()
+                        client.pull()
+                        dt = time.perf_counter() - t0
+                        pull_rtts[k].append(dt)
+                        h_pull.observe(dt)
                     t0 = time.perf_counter()
                     client.commit(delta)
-                    rtts[k].append(time.perf_counter() - t0)
+                    dt = time.perf_counter() - t0
+                    rtts[k].append(dt)
+                    h_commit.observe(dt)
                 wire_bytes[k] = creg.counter("net.bytes_sent").value \
                     + creg.counter("net.bytes_recv").value - b0
+                down_bytes[k] = creg.counter("ps.wire.bytes_down").value \
+                    - d0
+                subs = getattr(client, "clients", None)
+                # a sharded link counts only when EVERY shard connection
+                # negotiated rings — a partial fleet is a TCP-mixed
+                # measurement, not an shm one
+                shm_active[k] = all(c.shm_active for c in subs) if subs \
+                    else bool(getattr(client, "shm_active", False))
         except BaseException as e:  # surfaced after join — never hang
             errors.append(e)
 
@@ -841,45 +911,77 @@ def bench_ps(codec: str = "none", windows: int = 50, mb: float = 4.0,
 
     raw = _counter(merged, "ps.codec.bytes_raw")
     enc = _counter(merged, "ps.codec.bytes_encoded")
+    down_raw = _counter(merged, "ps.down.bytes_raw")
+    down_enc = _counter(merged, "ps.down.bytes_encoded")
     all_rtts = np.concatenate([np.asarray(r) for r in rtts])
+    all_pulls = np.concatenate([np.asarray(r) for r in pull_rtts])
     total_windows = ps_workers * windows
+    total_pulls = total_windows * pull_ratio
     row = {
         "metric": "ps commit RTT (localhost, "
                   f"{mb:g} MB center, codec={codec}, "
                   f"workers={ps_workers}"
                   + (f", shards={ps_shards}" if ps_shards > 1 else "")
+                  + (f", down={down}" if down != "none" else "")
+                  + (", shm" if all(shm_active) and shm else "")
                   + ")",
         "mode": "bench_ps", "codec": codec, "windows": windows,
         "ps_workers": ps_workers,
         "ps_shards": ps_shards,
         "ps_shard_placement": ps_shard_placement,
         "center_mb": round(mb, 3),
+        "down": down, "pull_ratio": pull_ratio,
+        #: True only when EVERY client negotiated the same-host rings —
+        #: a refused offer (cross-host, old server) silently staying on
+        #: TCP must not be read as an shm measurement
+        "shm": bool(shm and all(shm_active)),
         "commit_rtt_ms_p50": round(float(np.median(all_rtts)) * 1e3, 3),
         "commit_rtt_ms_p99": round(float(np.quantile(all_rtts, 0.99)) * 1e3,
                                    3),
+        "pull_rtt_ms_p50": round(float(np.median(all_pulls)) * 1e3, 3),
+        "pull_rtt_ms_p99": round(float(np.quantile(all_pulls, 0.99)) * 1e3,
+                                 3),
+        **({"pull_rtt_ms_p50_tcp_ref": round(float(np.median(
+            np.concatenate([np.asarray(r) for r in tcp_pull_rtts])))
+            * 1e3, 3)} if shm else {}),
         "wire_bytes_per_window": round(sum(wire_bytes)
                                        / max(1, total_windows)),
+        #: DOWN direction (ISSUE 12): bytes the pulled centers took per
+        #: fresh pull — the number reference-residual compression cuts
+        "wire_bytes_down_per_pull": round(sum(down_bytes)
+                                          / max(1, total_pulls)),
         #: as NEGOTIATED on the live connections (env pins like
         #: DKTPU_WIRE=1 and server refusals included) — benchmark
         #: provenance must name the frame format that carried the traffic
         "wire_version": min(negotiated),
         "compression_ratio": round(raw / enc, 3) if enc else 1.0,
+        "down_compression_ratio": round(down_raw / down_enc, 3)
+        if down_enc else 1.0,
         "bytes_saved": _counter(merged, "ps.codec.bytes_saved"),
     }
     # the single-worker snapshot name follows OBS_BASELINE.json's
     # ``snapshots.ps_bench`` mapping so a remapped baseline is both
     # checked against AND refreshed (the trainer bench does the same)
     bl_cfg = _baseline_cfg()
-    base_path = _baseline_snapshot_path(bl_cfg, "ps_bench",
-                                        "BENCH_PS_OBS.json")
+    if ps_workers == 1 and row["shm"]:
+        # the single-worker shm point is its own committed baseline —
+        # the pull-p50 shm-vs-TCP comparison needs BOTH files stable
+        base_path = _baseline_snapshot_path(bl_cfg, "ps_bench_shm",
+                                            "BENCH_PS_OBS_shm.json")
+    else:
+        base_path = _baseline_snapshot_path(bl_cfg, "ps_bench",
+                                            "BENCH_PS_OBS.json")
     name = os.path.basename(base_path) if ps_workers == 1 \
         else f"BENCH_PS_OBS_w{ps_workers}.json"
     snap_path = os.path.join(out_dir, name)
-    # config carries the shard keys only when sharded: the committed
-    # pre-shard baselines must keep matching un-sharded reruns exactly
+    # config carries the shard/down/shm keys only when active: committed
+    # baselines of the plain workload must keep matching plain reruns
     cfg_keys = ("codec", "windows", "center_mb", "ps_workers",
-                "wire_version") + (("ps_shards", "ps_shard_placement")
-                                   if ps_shards > 1 else ())
+                "wire_version") \
+        + (("ps_shards", "ps_shard_placement") if ps_shards > 1 else ()) \
+        + (("down",) if down != "none" else ()) \
+        + (("pull_ratio",) if pull_ratio != 1 else ()) \
+        + (("shm",) if row["shm"] else ())
     obs_doc = {"config": {k: row[k] for k in cfg_keys},
                "client": merged,
                "server": server_snap}
@@ -946,6 +1048,16 @@ def _cli(argv=None) -> int:
                          "phase")
     ap.add_argument("--codec", default="none",
                     help="bench_ps commit codec: none|int8|bf16|topk<frac>")
+    ap.add_argument("--down", default="none",
+                    help="bench_ps DOWN pull-compression spec (ISSUE 12): "
+                         "none|int8|bf16|topk<frac>|adaptive")
+    ap.add_argument("--pull-ratio", type=int, default=1,
+                    help="bench_ps: fresh pulls per commit window — the "
+                         "pull-heavy phase; DOWN bytes and pull RTT "
+                         "p50/p99 get their own row fields")
+    ap.add_argument("--shm", action="store_true",
+                    help="bench_ps: negotiate the same-host shared-memory "
+                         "transport (tensor segments skip TCP)")
     ap.add_argument("--windows", type=int, default=50,
                     help="bench_ps pull+commit windows")
     ap.add_argument("--mb", type=float, default=4.0,
@@ -1005,12 +1117,16 @@ def _cli(argv=None) -> int:
             ap.error(f"--windows must be >= 1 (got {args.windows})")
         if args.ps_shards < 1:
             ap.error(f"--ps-shards must be >= 1 (got {args.ps_shards})")
+        if args.pull_ratio < 1:
+            ap.error(f"--pull-ratio must be >= 1 (got {args.pull_ratio})")
         for n in points:
             print(json.dumps(bench_ps(
                 codec=args.codec, windows=args.windows, mb=args.mb,
                 wire_version=args.wire, ps_workers=n,
                 ps_shards=args.ps_shards,
-                ps_shard_placement=args.ps_shard_placement)))
+                ps_shard_placement=args.ps_shard_placement,
+                down=args.down, pull_ratio=args.pull_ratio,
+                shm=args.shm)))
         return 0
     main()
     return 0

@@ -1,6 +1,6 @@
 """dklint rules — repo-specific static checks for a distributed-JAX stack.
 
-Six rules, each targeting a hazard class this codebase actually has
+Seven rules, each targeting a hazard class this codebase actually has
 (ISSUE 3; the PS stack is exactly the shape of code where these corrupt
 training without failing a test):
 
@@ -28,6 +28,13 @@ training without failing a test):
   center.  The async algorithms' contract is pull-per-window; this is
   the lexical check for the one protocol slip a test's loss curve
   rarely catches.
+* ``shm-lifecycle`` — ``multiprocessing.shared_memory`` segments created
+  (``SharedMemory(create=True)`` / ``ShmRing.create``) in a scope with
+  no ``unlink`` on any shutdown path (ISSUE 12): a POSIX shm segment
+  outlives the process — close() releases the mapping but only the
+  creator's unlink() releases the /dev/shm backing, so a leak persists
+  until reboot.  Attach-only scopes (which must NOT unlink — the
+  creator owns that) are not flagged.
 """
 
 from __future__ import annotations
@@ -673,6 +680,81 @@ class StalenessProtocolRule(Rule):
         visit(fn.body, {})
 
 
+# ---------------------------------------------------------------------------
+# shm-lifecycle
+# ---------------------------------------------------------------------------
+
+
+class ShmLifecycleRule(Rule):
+    id = "shm-lifecycle"
+    description = ("shared-memory segment created in a scope with no "
+                   "unlink() on any shutdown path — the /dev/shm backing "
+                   "outlives the process")
+
+    @staticmethod
+    def _creates_segment(call: ast.Call) -> bool:
+        """``SharedMemory(create=True, ...)`` or ``ShmRing.create(...)``
+        — the two ways this codebase mints a segment it then OWNS.
+        ``SharedMemory(name=...)`` attachments are the peer side and
+        must not unlink; they are never flagged."""
+        if _terminal(call.func) == "SharedMemory":
+            return any(kw.arg == "create" and
+                       isinstance(kw.value, ast.Constant) and
+                       kw.value.value is True for kw in call.keywords)
+        return (_dotted(call.func) or "").endswith("ShmRing.create")
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+
+        def scope_of(node: ast.AST) -> ast.AST:
+            """Nearest enclosing ClassDef, else the outermost
+            FunctionDef, else the module — the region where the matching
+            unlink for this segment would plausibly live (same rule as
+            ``thread-shutdown``'s stop-path search)."""
+            cur, outer_fn = node, None
+            while id(cur) in parents:
+                cur = parents[id(cur)]
+                if isinstance(cur, ast.ClassDef):
+                    return cur
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    outer_fn = cur
+            return outer_fn if outer_fn is not None else ctx.tree
+
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and
+                    self._creates_segment(node)):
+                continue
+            if self._has_unlink_path(scope_of(node)):
+                continue
+            findings.append(self.finding(
+                ctx, node,
+                "shared-memory segment created with no unlink() in scope "
+                "— close() only drops the mapping; without the creator's "
+                "unlink() the /dev/shm backing leaks until reboot.  "
+                "Unlink on the shutdown path (or pass unlink=True to the "
+                "channel teardown)"))
+        return findings
+
+    @staticmethod
+    def _has_unlink_path(scope: ast.AST) -> bool:
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "unlink":
+                return True
+            # delegated teardown: ShmChannel.close_rings(unlink=True)
+            if any(kw.arg == "unlink" and
+                   isinstance(kw.value, ast.Constant) and
+                   kw.value.value is True for kw in node.keywords):
+                return True
+        return False
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     JitPurityRule(),
     LockDisciplineRule(),
@@ -680,6 +762,7 @@ ALL_RULES: Tuple[Rule, ...] = (
     ThreadShutdownRule(),
     BarePrintRule(),
     StalenessProtocolRule(),
+    ShmLifecycleRule(),
 )
 
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}

@@ -17,6 +17,21 @@ layered on:
 * **delta codecs** — an optional ``ps.codecs`` codec compresses commit
   payloads (int8/bf16/top-k with worker-side error feedback); encode
   latency and bytes saved land in this client's registry;
+* **DOWN compression** (ISSUE 12) — ``down=`` requests quantized pulls:
+  the server encodes each center as a residual against a shared
+  reference this connection acknowledges by epoch (full resync on the
+  first pull, after an epoch roll, and for every fresh incarnation —
+  a respawned worker's new client starts reference-less, so a stale
+  reference can never decode garbage).  ``down="adaptive"`` runs a
+  per-link :class:`~.codecs.AdaptiveDownPolicy` choosing the codec from
+  this client's measured pull RTTs, with hysteresis and a recorded
+  ``ps.codec.switches`` trail;
+* **shared-memory transport** (ISSUE 12) — ``shm=True`` (or
+  ``DKTPU_SHM=1``) offers a same-host data plane in the hello: this
+  client creates one ring per direction and the server acks only if it
+  can actually attach them; v2 tensor segments then skip TCP entirely.
+  Refused negotiations (cross-host peers, old servers) silently stay on
+  TCP; this end owns the rings and unlinks them on close/reconnect;
 * **trace propagation** (ISSUE 5) — with a ``tracer``, pull/commit run
   inside ``ps.pull``/``ps.commit`` spans and, on v2 connections, ship the
   open span's ``(trace_id, parent_span)`` as a ``trace`` header so the
@@ -38,14 +53,22 @@ owns that failure, as in the reference's Spark task retry).
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 from typing import Any, Optional
 
 from ..obs import TIME_BUCKETS, Registry, default_registry
+from ..obs.logging import get_logger
 from ..obs.spans import SpanTracer
 from . import codecs
-from .networking import (client_handshake, connect, pinned_wire_version,
-                         recv_msg, retry_with_backoff, send_msg)
+from .networking import (SHM_RING_MB, ShmChannel, ShmRing, client_handshake,
+                         connect, pinned_wire_version, recv_msg,
+                         retry_with_backoff, send_msg)
+
+#: direction-tagged wire counters (ISSUE 12): on the worker side, sends
+#: are UP (commits/requests) and receives are DOWN (pulled centers)
+_UP = "ps.wire.bytes_up"
+_DOWN = "ps.wire.bytes_down"
 
 
 class WorkerEvicted(RuntimeError):
@@ -60,7 +83,9 @@ class PSClient:
                  registry: Optional[Registry] = None,
                  codec=None, wire_version: Optional[int] = None,
                  tracer: Optional[SpanTracer] = None,
-                 generation: int = 0):
+                 generation: int = 0, down=None,
+                 shm: Optional[bool] = None,
+                 shm_mb: Optional[float] = None):
         self.worker_id = int(worker_id)
         #: commit generation this incarnation runs under (ISSUE 9):
         #: stamped on every commit so a post-eviction zombie's deltas
@@ -99,8 +124,47 @@ class PSClient:
         #: (ISSUE 10) — None against a plain (un-sharded) server or on a
         #: v1 connection (no hello is sent)
         self.shard_info: Optional[dict] = None
+        #: DOWN pull compression (ISSUE 12): the requested spec, whether
+        #: the server acked it, the per-link adaptive policy (when
+        #: ``down="adaptive"``), and the (epoch, tree) reference this
+        #: connection last acknowledged — reset on every (re)connect so
+        #: a fresh incarnation always resyncs
+        self.down_spec = codecs.validate_down_spec(down)
+        self.down_enabled = False
+        self._down_policy: Optional[codecs.AdaptiveDownPolicy] = None
+        self._down_ref: Optional[tuple] = None
+        self._down_req: Optional[str] = None
+        self._c_resyncs = self.registry.counter("ps.down.resyncs")
+        self._h_down_decode = self.registry.histogram(
+            "ps.down.decode_seconds", TIME_BUCKETS)
+        #: same-host shared-memory transport (ISSUE 12): requested via
+        #: the ``shm`` arg or ``DKTPU_SHM=1``; active only after the
+        #: server proves it can attach this client's rings
+        self.shm_requested = bool(shm) if shm is not None \
+            else os.environ.get("DKTPU_SHM") == "1"
+        self.shm_mb = float(shm_mb) if shm_mb is not None else SHM_RING_MB
+        self.shm_active = False
+        self._chan = None
         self.sock = connect(host, port)
         self._handshake()
+
+    def _make_rings(self) -> Optional[tuple]:
+        """(c2s, s2c) rings for the shm offer, or None when creation
+        fails (no /dev/shm, quota) — the connection then stays TCP."""
+        try:
+            size = max(1 << 20, int(self.shm_mb * (1 << 20)))
+            c2s = ShmRing.create(size)
+            try:
+                s2c = ShmRing.create(size)
+            except OSError:
+                c2s.unlink()
+                c2s.close()
+                raise
+            return c2s, s2c
+        except OSError as e:
+            get_logger("ps.client").warning(
+                "cannot create shared-memory rings (%s); staying on TCP", e)
+            return None
 
     def _handshake(self) -> None:
         """Negotiate the wire format for this connection (the shared
@@ -109,12 +173,62 @@ class PSClient:
         its placement descriptor (``shard``: index / num_shards / plan
         epoch / plan digest — ISSUE 10), captured here so the sharded
         client can verify agreement at negotiation time; plain servers
-        leave it None."""
+        leave it None.  ISSUE 12 extras — the DOWN-codec advertisement
+        and the shm ring offer — ride the same hello, included only when
+        requested so the default handshake stays byte-identical."""
+        extras: dict = {}
+        if self.down_spec != "none":
+            extras["down"] = {"codecs": list(codecs.DOWN_CODECS)}
+        rings = None
+        pinned = pinned_wire_version(self._want_version)
+        if self.shm_requested and (pinned is None or pinned >= 2):
+            # a v1-pinned connection sends no hello: creating (and
+            # immediately unlinking) 2 x shm_mb of /dev/shm per dial
+            # would be pure waste
+            rings = self._make_rings()
+            if rings is not None:
+                extras["shm"] = {"c2s": rings[0].name, "s2c": rings[1].name,
+                                 "size": rings[0].size}
         info: dict = {}
-        self.wire_version = client_handshake(
-            self.sock, registry=self.registry, worker_id=self.worker_id,
-            want=self._want_version, info=info)
+        try:
+            self.wire_version = client_handshake(
+                self.sock, registry=self.registry, worker_id=self.worker_id,
+                want=self._want_version, info=info,
+                extras=extras or None)
+        except BaseException:
+            if rings is not None:
+                for r in rings:
+                    r.unlink()
+                    r.close()
+            raise
         self.shard_info = info.get("shard")
+        self._down_ref = None
+        self.down_enabled = (self.down_spec != "none"
+                             and self.wire_version >= 2
+                             and bool((info.get("down") or {}).get("ok")))
+        if self.down_enabled and self.down_spec == "adaptive" \
+                and self._down_policy is None:
+            # the policy survives reconnects: its EWMAs describe the
+            # LINK, which is the same network path either way
+            self._down_policy = codecs.AdaptiveDownPolicy(self.registry)
+        self.shm_active = False
+        self._chan = self.sock
+        if rings is not None:
+            if (info.get("shm") or {}).get("ok"):
+                self._chan = ShmChannel(self.sock, tx=rings[0], rx=rings[1])
+                self.shm_active = True
+            else:
+                # refused (cross-host server, old server): this end owns
+                # the segments — destroy them now, not at GC
+                for r in rings:
+                    r.unlink()
+                    r.close()
+
+    def _teardown_shm(self) -> None:
+        if isinstance(self._chan, ShmChannel):
+            self._chan.close_rings(unlink=True)
+        self._chan = self.sock
+        self.shm_active = False
 
     def reconnect(self, attempts: int = 6, base_delay: float = 0.1,
                   max_delay: float = 2.0) -> None:
@@ -130,6 +244,7 @@ class PSClient:
         restart takes seconds, and a fleet re-dialing in lockstep is a
         thundering herd); each failed attempt counts under
         ``ps.client.reconnect_failures``, the final one re-raises."""
+        self._teardown_shm()  # dead connection's rings: unlink now
         try:
             self.sock.close()
         except OSError:
@@ -140,6 +255,7 @@ class PSClient:
             # one dial per attempt: the backoff (not connect's own
             # fixed-cadence retry loop) paces the re-dials
             self.sock = connect(self.host, self.port, retries=1)
+            self._chan = self.sock
             self._handshake()
 
         retry_with_backoff(dial, attempts, base_delay, max_delay,
@@ -154,16 +270,18 @@ class PSClient:
         idempotent reads."""
         t0 = time.perf_counter()
         try:
-            send_msg(self.sock, msg, registry=self.registry,
-                     version=self.wire_version)
-            resp = recv_msg(self.sock, registry=self.registry)
+            send_msg(self._chan, msg, registry=self.registry,
+                     version=self.wire_version, count_as=_UP)
+            resp = recv_msg(self._chan, registry=self.registry,
+                            count_as=_DOWN)
         except (ConnectionError, OSError):
             if not retry:
                 raise
             self.reconnect()
-            send_msg(self.sock, msg, registry=self.registry,
-                     version=self.wire_version)
-            resp = recv_msg(self.sock, registry=self.registry)
+            send_msg(self._chan, msg, registry=self.registry,
+                     version=self.wire_version, count_as=_UP)
+            resp = recv_msg(self._chan, registry=self.registry,
+                            count_as=_DOWN)
         self._h_rtt.observe(time.perf_counter() - t0)
         return resp
 
@@ -221,6 +339,14 @@ class PSClient:
             msg["have"] = have
         if min_updates is not None:
             msg["min_updates"] = int(min_updates)
+        if self.down_enabled:
+            codec = self._down_policy.next_codec() \
+                if self._down_policy is not None else self.down_spec
+            self._down_req = codec
+            d: dict = {"codec": codec}
+            if self._down_ref is not None:
+                d["ref_epoch"] = int(self._down_ref[0])
+            msg["down"] = d
         return msg
 
     def pull_send(self, min_updates: Optional[int] = None) -> None:
@@ -231,8 +357,9 @@ class PSClient:
         consistent-cut retry hint (old servers ignore it)."""
         self._t_pull = time.perf_counter()
         have = self._last_pull[1] if self._last_pull is not None else None
-        send_msg(self.sock, self._pull_msg(have, min_updates),
-                 registry=self.registry, version=self.wire_version)
+        send_msg(self._chan, self._pull_msg(have, min_updates),
+                 registry=self.registry, version=self.wire_version,
+                 count_as=_UP)
 
     def pull_finish(self) -> tuple:
         """Phase 2 of a pull: ``(center, updates, version_vector,
@@ -242,11 +369,13 @@ class PSClient:
         plain servers leave both None.  An ``unchanged`` answer reuses
         the cached center/vv/epoch — they can only change when the
         counter does."""
-        resp = recv_msg(self.sock, registry=self.registry)
+        resp = recv_msg(self._chan, registry=self.registry, count_as=_DOWN)
         self._h_rtt.observe(time.perf_counter() - self._t_pull)
         self._raise_on_error("pull", resp)
         updates = int(resp["updates"])
         if resp.get("unchanged"):
+            # unchanged replies are codec-free and near-instant: never
+            # fold their RTT into the adaptive policy's per-codec EWMAs
             if self._last_pull is not None:
                 self._c_unchanged.inc()
                 return (self._last_pull[0], updates,
@@ -257,12 +386,52 @@ class PSClient:
             resp = self._rpc(self._pull_msg())
             self._raise_on_error("pull", resp)
             updates = int(resp["updates"])
+        center = self._decode_down(resp)
+        if self._down_policy is not None and self._down_req is not None:
+            # measured AFTER decode: the per-codec EWMAs must fold in
+            # this end's decode cost, or a heavy-decode codec looks
+            # cheaper than it is end to end
+            self._down_policy.observe(
+                (resp.get("down") or {}).get("codec", "none")
+                if isinstance(resp.get("down"), dict) else "none",
+                time.perf_counter() - self._t_pull)
         vv = resp.get("vv")
         if isinstance(vv, dict):
             vv = {int(k): int(v) for k, v in vv.items()}
         epoch = resp.get("plan_epoch")
-        self._last_pull = (resp["center"], updates, vv, epoch)
-        return resp["center"], updates, vv, epoch
+        self._last_pull = (center, updates, vv, epoch)
+        return center, updates, vv, epoch
+
+    def _decode_down(self, resp: dict):
+        """The pulled center: raw (``center`` key — v1 peers, down
+        disabled, or the adaptive policy picked "none") or decoded from
+        the DOWN residual against this connection's acknowledged
+        reference (ISSUE 12).  A ``reference``-carrying reply is a full
+        resync: adopt it AND the epoch; a residual-only reply for an
+        epoch this connection does not hold is a protocol desync and
+        fails loudly rather than decode against the wrong reference."""
+        down = resp.get("down")
+        if not isinstance(down, dict):
+            return resp["center"]
+        t0 = time.perf_counter()
+        epoch = int(down["ref_epoch"])
+        ref = down.get("reference")
+        if ref is not None:
+            self._down_ref = (epoch, ref)
+            self._c_resyncs.inc()
+        elif self._down_ref is None or self._down_ref[0] != epoch:
+            raise RuntimeError(
+                f"ps pull: server encoded against reference epoch "
+                f"{epoch} but this connection holds "
+                f"{None if self._down_ref is None else self._down_ref[0]}")
+        center = codecs.apply_ref_delta(self._down_ref[1], down["residual"])
+        codecs.count_codec_bytes(
+            self.registry, codecs.tree_payload_bytes(center),
+            codecs.tree_payload_bytes(down["residual"])
+            + (codecs.tree_payload_bytes(ref) if ref is not None else 0),
+            prefix="ps.down")
+        self._h_down_decode.observe(time.perf_counter() - t0)
+        return center
 
     def pull_versioned(self) -> tuple:
         """The full pull protocol in one call (transparently reconnects
@@ -299,14 +468,14 @@ class PSClient:
         if last_update is not None:
             msg["last_update"] = int(last_update)
         self._t_commit = time.perf_counter()
-        send_msg(self.sock, msg, registry=self.registry,
-                 version=self.wire_version)
+        send_msg(self._chan, msg, registry=self.registry,
+                 version=self.wire_version, count_as=_UP)
 
     def commit_finish(self) -> bool:
         """Phase 2 of a commit: True when applied, False when a fault
         injector dropped it; an eviction notice raises
         :class:`WorkerEvicted`."""
-        resp = recv_msg(self.sock, registry=self.registry)
+        resp = recv_msg(self._chan, registry=self.registry, count_as=_DOWN)
         self._h_rtt.observe(time.perf_counter() - self._t_commit)
         # a server-side apply failure answers {"ok": False, "error"}
         # (it did NOT apply the delta) — that must surface as a
@@ -335,6 +504,15 @@ class PSClient:
             self.commit_send(delta, last_update=last_update, gap_s=gap_s)
             return self.commit_finish()
 
+    def invalidate(self) -> None:
+        """Drop the client-side center cache: the next pull ships a full
+        center even at an unchanged counter (reconnect does this
+        implicitly; callers use it after out-of-band center changes —  a
+        restored checkpoint — and the pull-heavy bench phase uses it to
+        measure fresh-pull RTTs).  The DOWN reference is kept: it is
+        per-connection wire state, still valid for residual decode."""
+        self._last_pull = None
+
     def stats(self) -> dict:
         """Poll the server's live telemetry: ``{"stats": <registry
         snapshot>, "num_updates": int, "commits_by_worker": dict, ...}`` —
@@ -344,12 +522,18 @@ class PSClient:
 
     def close(self) -> None:
         try:
-            send_msg(self.sock, {"action": "stop"}, registry=self.registry,
-                     version=self.wire_version)
-            recv_msg(self.sock, registry=self.registry)
+            # over the negotiated channel: a shm server answers even the
+            # stop ack on the ring
+            send_msg(self._chan, {"action": "stop"},
+                     registry=self.registry, version=self.wire_version)
+            recv_msg(self._chan, registry=self.registry)
         except (ConnectionError, OSError):
             pass
         finally:
+            # this end created the shm segments: destroy them on the
+            # shutdown path (dklint shm-lifecycle), after the stop
+            # exchange so the server's handler is already done with them
+            self._teardown_shm()
             try:
                 self.sock.close()
             except OSError:

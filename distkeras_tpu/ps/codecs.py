@@ -34,15 +34,29 @@ into the caller's registry (compression ratio = raw/encoded); encode and
 decode latency land in ``ps.codec.encode_seconds`` /
 ``ps.codec.decode_seconds`` histograms at the call sites
 (``ps.client`` / ``ps.servers``).
+
+ISSUE 12 adds the **DOWN direction**: every pull used to ship the full
+raw center.  :func:`encode_ref_delta` / :func:`apply_ref_delta` quantize
+the center as a residual against a **reference center** both ends hold
+(the server's shared per-K-counters snapshot — ``ps.state.DownRefState``)
+using the same stateless per-leaf stubs, so any UP codec's decoder
+already understands the DOWN wire.  No error feedback is needed DOWN:
+each pull encodes ``center - reference`` fresh, so quantization error is
+bounded per pull, never accumulated.  :class:`AdaptiveDownPolicy` picks
+the DOWN codec per connection from the client-measured RTT-vs-bytes
+ratios, with hysteresis and a recorded ``ps.codec.switches`` trail.
 """
 
 from __future__ import annotations
 
+import collections
 from typing import Any, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..obs.logging import get_logger
 
 _MARK = "__dkcodec__"
 
@@ -256,8 +270,159 @@ def tree_payload_bytes(tree: Tree) -> int:
     return total
 
 
-def count_codec_bytes(registry, raw: int, encoded: int) -> None:
-    """Fold one encode/decode's byte accounting into ``registry``."""
-    registry.counter("ps.codec.bytes_raw").inc(raw)
-    registry.counter("ps.codec.bytes_encoded").inc(encoded)
-    registry.counter("ps.codec.bytes_saved").inc(max(0, raw - encoded))
+def count_codec_bytes(registry, raw: int, encoded: int,
+                      prefix: str = "ps.codec") -> None:
+    """Fold one encode/decode's byte accounting into ``registry``.
+    ``prefix`` splits the ledgers: ``ps.codec`` is the UP (commit)
+    direction, ``ps.down`` the DOWN (pull) direction (ISSUE 12)."""
+    registry.counter(f"{prefix}.bytes_raw").inc(raw)
+    registry.counter(f"{prefix}.bytes_encoded").inc(encoded)
+    registry.counter(f"{prefix}.bytes_saved").inc(max(0, raw - encoded))
+
+
+# ---------------------------------------------------------------------------
+# DOWN direction: reference/residual center compression (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+#: DOWN codec specs a current client can decode — advertised in the
+#: hello so a newer server never ships a stub this build cannot open
+DOWN_CODECS = ("int8", "bf16", "topk")
+
+
+def validate_down_spec(spec) -> str:
+    """Normalize/validate a ``comm_down`` spec: ``None``/"none" (raw
+    pulls, the bit-identical default), "adaptive" (per-link policy), or
+    any non-identity ``get_codec`` spec ("int8" / "bf16" / "topk<frac>")."""
+    if spec is None or spec == "none":
+        return "none"
+    if spec == "adaptive":
+        return "adaptive"
+    codec = get_codec(spec)
+    if codec.is_identity:
+        raise ValueError(f"comm_down {spec!r} is an identity codec; use "
+                         f"'none' to disable DOWN compression")
+    return codec.name
+
+
+def encode_ref_delta(center: Tree, ref: Tree, spec: str) -> Tree:
+    """Encode ``center`` as a quantized residual against ``ref`` (the
+    reference center the peer already holds): floating leaves become the
+    same self-describing stubs the UP codecs ship (``center - ref``
+    through ``spec``'s leaf encoder), non-floating/empty leaves pass
+    through verbatim.  Stateless — no error feedback: the residual is
+    recomputed against the reference every pull, so quantization error
+    is bounded per pull, never accumulated."""
+    codec = get_codec(spec)
+
+    def enc(c, r):
+        c = np.asarray(c)
+        if not _floating(c) or c.size == 0:
+            return c
+        return codec._enc_leaf((c - np.asarray(r)).astype(c.dtype))
+
+    return jax.tree_util.tree_map(enc, center, ref)
+
+
+def apply_ref_delta(ref: Tree, residual: Tree) -> Tree:
+    """Inverse of :func:`encode_ref_delta`: ``ref + decode(stub)`` per
+    stub leaf (new arrays — pulled trees stay read-only), pass-through
+    leaves adopted as-is."""
+
+    def dec(r, s):
+        if _is_stub(s):
+            r = np.asarray(r)
+            return (r + _DECODERS[s[_MARK]](s).astype(r.dtype, copy=False))
+        return s
+
+    return jax.tree_util.tree_map(dec, ref, residual,
+                                  is_leaf=lambda x: _is_stub(x))
+
+
+class AdaptiveDownPolicy:
+    """Per-link DOWN codec selection from measured pull RTTs (ISSUE 12).
+
+    Lives on the CLIENT — the end that actually measures the link: each
+    pull's round-trip (which already folds in the server's encode time,
+    the transfer, and this end's decode) is attributed to the codec that
+    carried it.  The policy seeds an EWMA per candidate during a warmup
+    sweep, then serves the argmin — with **hysteresis**: a challenger
+    must beat the incumbent by ``margin`` on ``patience`` consecutive
+    evaluations before a switch, so RTT noise never flaps the link.
+    Every switch increments ``ps.codec.switches`` and appends to the
+    bounded :attr:`trail` (the recorded decision log obsview and tests
+    read); a periodic re-probe keeps the losers' EWMAs honest as link
+    conditions drift.
+    """
+
+    def __init__(self, registry, candidates=("none", "bf16", "int8"),
+                 margin: float = 0.2, patience: int = 3,
+                 reprobe_every: int = 25, alpha: float = 0.3,
+                 warmup_samples: int = 2):
+        for c in candidates:
+            if c != "none":
+                validate_down_spec(c)
+        self.candidates = tuple(candidates)
+        self.margin = float(margin)
+        self.patience = int(patience)
+        self.reprobe_every = int(reprobe_every)
+        self.alpha = float(alpha)
+        self.warmup_samples = int(warmup_samples)
+        self.current = self.candidates[0]
+        self._ewma: dict = {}
+        self._samples: dict = {c: 0 for c in self.candidates}
+        self._streak_for: Optional[str] = None
+        self._streak = 0
+        self._n = 0
+        self._probe_cursor = 0
+        #: bounded decision log: one entry per switch
+        self.trail: collections.deque = collections.deque(maxlen=256)
+        self._c_switches = registry.counter("ps.codec.switches")
+        self._log = get_logger("ps.down")
+
+    def next_codec(self) -> str:
+        """The codec the NEXT pull should request."""
+        for c in self.candidates:  # warmup: seed every candidate's EWMA
+            if self._samples[c] < self.warmup_samples:
+                return c
+        self._n += 1
+        if self.reprobe_every and self._n % self.reprobe_every == 0:
+            others = [c for c in self.candidates if c != self.current]
+            if others:
+                self._probe_cursor = (self._probe_cursor + 1) % len(others)
+                return others[self._probe_cursor]
+        return self.current
+
+    def observe(self, codec: str, rtt_s: float) -> None:
+        """Fold one pull's measured RTT into ``codec``'s EWMA and
+        re-evaluate the incumbent."""
+        if codec not in self.candidates or not np.isfinite(rtt_s) \
+                or rtt_s < 0:
+            return
+        self._samples[codec] += 1
+        prev = self._ewma.get(codec)
+        self._ewma[codec] = float(rtt_s) if prev is None \
+            else (1 - self.alpha) * prev + self.alpha * float(rtt_s)
+        if any(self._samples[c] < self.warmup_samples
+               for c in self.candidates):
+            return
+        best = min(self.candidates, key=lambda c: self._ewma[c])
+        if best == self.current or \
+                self._ewma[best] >= self._ewma[self.current] * \
+                (1.0 - self.margin):
+            self._streak_for, self._streak = None, 0
+            return
+        if self._streak_for == best:
+            self._streak += 1
+        else:
+            self._streak_for, self._streak = best, 1
+        if self._streak >= self.patience:
+            ratio = self._ewma[self.current] / max(self._ewma[best], 1e-12)
+            self.trail.append({"pull": self._n, "from": self.current,
+                               "to": best, "rtt_ratio": round(ratio, 3)})
+            self._log.info(
+                "adaptive DOWN codec switch: %s -> %s (EWMA RTT ratio "
+                "%.2fx over %d consecutive evaluations)", self.current,
+                best, ratio, self._streak)
+            self.current = best
+            self._c_switches.inc()
+            self._streak_for, self._streak = None, 0

@@ -38,11 +38,35 @@ generation 0) and a process-wide fault-injection seam
 (:func:`set_fault_hook`) the chaos harness uses to inject socket resets
 and timeouts into the negotiation and commit paths.
 
+ISSUE 12 adds two transport-level pieces:
+
+* **Direction-tagged wire counters** — send/recv calls that name a
+  ``count_as`` counter additionally fold the message's bytes into it
+  (``ps.wire.bytes_up`` for worker->server traffic, ``ps.wire.bytes_down``
+  for server->worker), so DOWN-compression savings are directly
+  observable; the aggregate ``net.bytes_sent``/``net.bytes_recv`` totals
+  keep their historical meaning for baseline continuity.
+* **Same-host shared-memory transport** — negotiated in the existing
+  ``hello`` seam like the v2 frame: the client creates two
+  ``multiprocessing.shared_memory`` rings and ships their names in the
+  hello; a server that can actually attach them (the capability probe —
+  no host heuristics) acks, and from then on v2 messages travel as a
+  ``DKW3`` control frame over TCP (header + length table + ring offset)
+  with the tensor segments exchanged through the ring: one memcpy,
+  no kernel socket path, for co-located peers (the cluster runner
+  co-locates PS shards and workers on process 0's host; thread-placed
+  shard fleets are all-local by construction).  Messages too big for the
+  ring transparently fall back to the TCP frame per message — the
+  receiver auto-detects ``DKW2`` vs ``DKW3`` like it auto-detects v1/v2.
+  The ring owner (client) unlinks on close; attachments just close.
+
 Instrumented (ISSUE 2): every framed send/recv counts messages and wire
 bytes (frame header included) into an ``obs.Registry`` — the component's
 own when the caller passes one (the PS server's ``STATS`` snapshot counts
 its traffic), the process-wide default otherwise; ``connect`` counts
-attempts that failed-and-retried.
+attempts that failed-and-retried.  Shared-memory segment bytes count in
+the same totals (they are message bytes, whatever plane carried them)
+plus ``net.bytes_shm`` for the share that bypassed TCP.
 """
 
 from __future__ import annotations
@@ -60,6 +84,7 @@ from ..utils import serde
 
 _LEN = struct.Struct(">Q")
 _MAGIC2 = b"DKW2"
+_MAGIC3 = b"DKW3"  # shm data plane: control frame on TCP, segments in the ring
 _V2HEAD = struct.Struct(">4sI")  # magic + segment count
 
 #: newest frame format this build speaks; the hello handshake negotiates
@@ -190,7 +215,8 @@ def choose_wire_version(offered: Optional[Sequence[int]],
 def client_handshake(sock: socket.socket, registry=None,
                      worker_id: Optional[int] = None,
                      want: Optional[int] = None,
-                     info: Optional[dict] = None) -> int:
+                     info: Optional[dict] = None,
+                     extras: Optional[dict] = None) -> int:
     """Client side of the hello handshake; returns the negotiated wire
     version for this connection.  The hello itself is always v1-framed
     (any server parses it); current servers answer with the agreed
@@ -200,7 +226,10 @@ def client_handshake(sock: socket.socket, registry=None,
     ``info``, when given, is updated in place with the server's full
     hello reply — the channel for negotiation-time extras like a shard
     front-end's placement descriptor (ISSUE 10); old servers' replies
-    simply carry no extra keys."""
+    simply carry no extra keys.  ``extras`` rides in the hello REQUEST
+    the same way (ISSUE 12: the DOWN-codec advertisement and the shm
+    ring names) — included only when the caller opted in, so the default
+    hello stays byte-identical to previous builds."""
     want = pinned_wire_version(want)
     want = WIRE_VERSION if want is None else int(want)
     if want < 2:
@@ -209,6 +238,8 @@ def client_handshake(sock: socket.socket, registry=None,
     msg: dict = {"action": "hello", "versions": list(range(1, want + 1))}
     if worker_id is not None:
         msg["worker_id"] = int(worker_id)
+    if extras:
+        msg.update(extras)
     send_msg(sock, msg, registry=registry)
     resp = recv_msg(sock, registry=registry)
     if info is not None and isinstance(resp, dict):
@@ -216,6 +247,169 @@ def client_handshake(sock: socket.socket, registry=None,
     if resp.get("ok"):
         return int(resp.get("version", 1))
     return 1
+
+
+# ---------------------------------------------------------------------------
+# same-host shared-memory data plane (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+#: default ring capacity; a message whose segments exceed the ring falls
+#: back to the TCP frame for that message, so this bounds memory, not
+#: message size
+SHM_RING_MB = float(os.environ.get("DKTPU_SHM_MB", 64))
+
+
+class ShmRing:
+    """One-direction tensor-segment ring over a
+    ``multiprocessing.shared_memory`` segment.
+
+    The TCP connection stays the control plane and strictly orders use:
+    the writer copies a message's segments into the ring BEFORE sending
+    the ``DKW3`` control frame, the reader copies them out after
+    receiving it, and the request/reply protocol allows one outstanding
+    message per connection — so a write can never overtake an unread
+    message.  Lifecycle: the CREATING end owns the segment and must
+    ``unlink()`` it on its shutdown path; attaching ends just
+    ``close()`` (the dklint ``shm-lifecycle`` rule guards exactly this
+    pairing)."""
+
+    def __init__(self, shm, owner: bool):
+        self._shm = shm
+        self.owner = owner
+        self.name = shm.name
+        self.size = shm.size
+        self._pos = 0
+
+    @classmethod
+    def create(cls, size: int) -> "ShmRing":
+        from multiprocessing import shared_memory
+        return cls(shared_memory.SharedMemory(create=True, size=int(size)),
+                   owner=True)
+
+    @classmethod
+    def attach(cls, name: str) -> "ShmRing":
+        from multiprocessing import shared_memory
+        shm = shared_memory.SharedMemory(name=str(name))
+        try:
+            # the attaching end must NOT own cleanup: unregister it from
+            # this process's resource tracker or interpreter shutdown
+            # "reclaims" (unlinks) a segment the creator still owns
+            from multiprocessing import resource_tracker
+            resource_tracker.unregister(shm._name, "shared_memory")
+        except (ImportError, AttributeError, KeyError):
+            pass
+        return cls(shm, owner=False)
+
+    def write(self, views: list) -> Optional[int]:
+        """Copy ``views`` contiguously into the ring; returns the start
+        offset, or None when they cannot fit (caller falls back to the
+        TCP frame for this message)."""
+        total = sum(v.nbytes for v in views)
+        if total > self.size:
+            return None
+        if self._pos + total > self.size:
+            self._pos = 0  # wrap: the previous message was already read
+        off = self._pos
+        buf = self._shm.buf
+        pos = off
+        for v in views:
+            buf[pos:pos + v.nbytes] = v
+            pos += v.nbytes
+        self._pos = pos
+        return off
+
+    def read(self, offset: int, lens: List[int]) -> List[bytearray]:
+        """Copy ``lens``-sized segments out of the ring starting at
+        ``offset`` — copies, so the writer's next message can never
+        mutate a tensor this one decoded."""
+        end = offset + sum(lens)
+        if offset < 0 or end > self.size:
+            raise ConnectionError(
+                f"shm frame outside the ring ({offset}..{end} of "
+                f"{self.size} bytes)")
+        out, pos = [], int(offset)
+        view = self._shm.buf
+        for n in lens:
+            out.append(bytearray(view[pos:pos + n]))
+            pos += n
+        return out
+
+    def close(self) -> None:
+        try:
+            self._shm.close()
+        except (OSError, BufferError):
+            pass
+
+    def unlink(self) -> None:
+        try:
+            # thread-placed peers attach in the CREATOR's process, and
+            # the attach-side unregister removed this process's tracker
+            # entry; re-register (idempotent set add) so the unregister
+            # inside SharedMemory.unlink balances instead of raising
+            # KeyError noise in the tracker at interpreter exit
+            from multiprocessing import resource_tracker
+            resource_tracker.register(self._shm._name, "shared_memory")
+        except (ImportError, AttributeError):
+            pass
+        try:
+            self._shm.unlink()
+        except (OSError, FileNotFoundError):
+            pass
+
+
+class ShmChannel:
+    """A negotiated connection: TCP control socket + one ring per
+    direction.  Passed anywhere a socket goes (``send_msg`` /
+    ``send_packed`` / ``recv_msg`` unwrap it); v2 payloads whose
+    segments fit ride the ring, everything else (v1 frames, oversized
+    messages) uses the socket unchanged."""
+
+    def __init__(self, sock: socket.socket, tx: ShmRing, rx: ShmRing):
+        self.sock = sock
+        self.tx = tx
+        self.rx = rx
+
+    @classmethod
+    def serve_attach(cls, sock: socket.socket, spec: dict) -> "ShmChannel":
+        """Server side: attach the client-created rings named in the
+        hello's ``shm`` spec.  Failure to attach (different host, dead
+        segment) raises — the capability probe that IS the same-host
+        check."""
+        rx = ShmRing.attach(spec["c2s"])
+        try:
+            tx = ShmRing.attach(spec["s2c"])
+        except BaseException:
+            rx.close()
+            raise
+        return cls(sock, tx=tx, rx=rx)
+
+    def close_rings(self, unlink: bool = False) -> None:
+        """Release both ring attachments; ``unlink=True`` additionally
+        destroys owned segments (the creating end's shutdown path)."""
+        for ring in (self.tx, self.rx):
+            if unlink and ring.owner:
+                ring.unlink()
+            ring.close()
+
+
+def _chan_parts(chan) -> Tuple[socket.socket, Optional[ShmChannel]]:
+    if isinstance(chan, ShmChannel):
+        return chan.sock, chan
+    return chan, None
+
+
+def _count_wire(reg, sent: bool, nbytes: int,
+                count_as: Optional[str]) -> None:
+    """One message's byte accounting: the aggregate ``net.*`` totals plus
+    the direction-tagged counter when the caller named one (ISSUE 12)."""
+    if sent:
+        reg.counter("net.msgs_sent").inc()
+        reg.counter("net.bytes_sent").inc(nbytes)
+    else:
+        reg.counter("net.msgs_recv").inc()
+        reg.counter("net.bytes_recv").inc(nbytes)
+    if count_as is not None:
+        reg.counter(count_as).inc(nbytes)
 
 
 # ---------------------------------------------------------------------------
@@ -271,23 +465,42 @@ def pack_msg(obj: Any, version: int = 1) -> Tuple[List[Any], int]:
 
 
 def send_packed(sock: socket.socket, payload: Tuple[List[Any], int],
-                registry=None) -> None:
-    """Send a :func:`pack_msg` payload (counted like any message)."""
+                registry=None, count_as: Optional[str] = None) -> None:
+    """Send a :func:`pack_msg` payload (counted like any message; the
+    optional ``count_as`` counter gets the direction-tagged total).  On a
+    negotiated :class:`ShmChannel`, v2 payloads whose segments fit the
+    ring travel as a ``DKW3`` control frame + ring segments; anything
+    else uses the TCP socket unchanged."""
+    sock, shm = _chan_parts(sock)
     bufs, total = payload
-    _sendmsg_all(sock, bufs)
     reg = registry if registry is not None else default_registry()
-    reg.counter("net.msgs_sent").inc()
-    reg.counter("net.bytes_sent").inc(total)
+    if shm is not None and len(bufs) >= 2 and \
+            bytes(bufs[0][:4]) == _MAGIC2:
+        views = [_flat_view(b) for b in bufs[2:]]
+        off = shm.tx.write(views)
+        if off is not None:
+            # control frame: v2 head with the shm magic + ring offset +
+            # the original length table; segments already in the ring
+            pre = memoryview(bufs[0])
+            ctrl = _V2HEAD.pack(_MAGIC3, len(bufs) - 2) + _LEN.pack(off) \
+                + bytes(pre[_V2HEAD.size:])
+            _sendmsg_all(sock, [ctrl, bufs[1]])
+            _count_wire(reg, True, total + _LEN.size, count_as)
+            reg.counter("net.bytes_shm").inc(sum(v.nbytes for v in views))
+            return
+    _sendmsg_all(sock, bufs)
+    _count_wire(reg, True, total, count_as)
 
 
 def send_msg(sock: socket.socket, obj: Any, registry=None,
-             version: int = 1) -> None:
+             version: int = 1, count_as: Optional[str] = None) -> None:
     """One framed message (parity: reference ``send_data``).  ``version=2``
     uses the zero-copy scatter-gather frame; the peer must have negotiated
     v2 (its ``recv_msg`` auto-detects either way)."""
     _inject_fault("send", obj.get("action") if isinstance(obj, dict)
                   else None)
-    send_packed(sock, pack_msg(obj, version=version), registry=registry)
+    send_packed(sock, pack_msg(obj, version=version), registry=registry,
+                count_as=count_as)
 
 
 # ---------------------------------------------------------------------------
@@ -315,31 +528,44 @@ def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
         view = view[got:]
 
 
-def recv_msg(sock: socket.socket, registry=None) -> Any:
-    """Recv-all loop for one framed message, v1/v2 auto-detected (parity:
-    reference ``recv_data``)."""
+def recv_msg(sock: socket.socket, registry=None,
+             count_as: Optional[str] = None) -> Any:
+    """Recv-all loop for one framed message, v1/v2/shm auto-detected
+    (parity: reference ``recv_data``)."""
     _inject_fault("recv")
+    sock, shm = _chan_parts(sock)
     head = _recv_exact(sock, _LEN.size)
     reg = registry if registry is not None else default_registry()
-    if head[:4] == _MAGIC2:
+    if head[:4] in (_MAGIC2, _MAGIC3):
         _, nseg = _V2HEAD.unpack(head)
+        extra = 0
+        if head[:4] == _MAGIC3:
+            if shm is None:
+                raise ConnectionError(
+                    "peer sent a shm frame on a connection with no "
+                    "negotiated shared-memory ring")
+            (off,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+            extra = _LEN.size
         table = _recv_exact(sock, _LEN.size * (nseg + 1))
         lens = [_LEN.unpack_from(table, i * _LEN.size)[0]
                 for i in range(nseg + 1)]
         header = _recv_exact(sock, lens[0])
-        segments = []
-        for n in lens[1:]:
-            buf = bytearray(n)
-            _recv_exact_into(sock, memoryview(buf))
-            segments.append(buf)
+        if head[:4] == _MAGIC3:
+            segments = shm.rx.read(off, lens[1:])
+            reg.counter("net.bytes_shm").inc(sum(lens[1:]))
+        else:
+            segments = []
+            for n in lens[1:]:
+                buf = bytearray(n)
+                _recv_exact_into(sock, memoryview(buf))
+                segments.append(buf)
         msg = serde.tree_from_frames(header, segments)
-        reg.counter("net.msgs_recv").inc()
-        reg.counter("net.bytes_recv").inc(len(head) + len(table) + sum(lens))
+        _count_wire(reg, False, len(head) + extra + len(table) + sum(lens),
+                    count_as)
         return msg
     (n,) = _LEN.unpack(head)
     msg = serde.tree_from_bytes(_recv_exact(sock, n))
-    reg.counter("net.msgs_recv").inc()
-    reg.counter("net.bytes_recv").inc(_LEN.size + n)
+    _count_wire(reg, False, _LEN.size + n, count_as)
     return msg
 
 
@@ -510,14 +736,35 @@ class FrameServer:
                                      if h.is_alive()]
                 self._threads.append(t)
 
+    def _negotiate_shm(self, conn: socket.socket, msg: dict, ver: int,
+                       reply: dict, log):
+        """Try to attach the client-created rings named in the hello's
+        ``shm`` spec (ISSUE 12).  Attach success IS the same-host check —
+        no hostname heuristics; a cross-host peer's open() simply fails
+        and the connection stays on TCP, ack-less."""
+        spec = msg.get("shm")
+        if not isinstance(spec, dict) or ver < 2:
+            return None
+        try:
+            chan = ShmChannel.serve_attach(conn, spec)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            log.info("shm negotiation refused (cross-host peer, or dead "
+                     "segment): %s", e)
+            return None
+        reply["shm"] = {"ok": True}
+        return chan
+
     def _handle_connection(self, conn: socket.socket):
         reg = self.registry
         log = get_logger(f"{self.metric_prefix}.server")
         ver = 1  # per-connection wire version; hello upgrades it
+        up = f"{self.metric_prefix}.wire.bytes_up"
+        down = f"{self.metric_prefix}.wire.bytes_down"
+        chan = conn  # hello may upgrade to a ShmChannel (ISSUE 12)
         try:
             while self._running.is_set():
                 try:
-                    msg = recv_msg(conn, registry=reg)
+                    msg = recv_msg(chan, registry=reg, count_as=up)
                 except (ConnectionError, OSError):
                     return
                 action = msg.get("action")
@@ -526,21 +773,26 @@ class FrameServer:
                     if action == "hello":
                         ver = choose_wire_version(msg.get("versions"),
                                                   self.max_wire_version)
-                        # the reply itself stays v1-framed: the client
-                        # switches only after reading it
-                        send_msg(conn, self.hello_reply(msg, ver),
-                                 registry=reg)
+                        reply = self.hello_reply(msg, ver)
+                        new_chan = self._negotiate_shm(conn, msg, ver,
+                                                       reply, log)
+                        # the reply itself stays v1-framed AND on TCP:
+                        # the client switches only after reading it
+                        send_msg(conn, reply, registry=reg, count_as=down)
+                        if new_chan is not None:
+                            chan = new_chan
                     elif action == "stop":
-                        send_msg(conn, {"ok": True}, registry=reg,
-                                 version=ver)
+                        send_msg(chan, {"ok": True}, registry=reg,
+                                 version=ver, count_as=down)
                         return
                     else:
-                        reply = self.handle_request(action, msg, ver, conn)
+                        reply = self.handle_request(action, msg, ver, chan)
                         if reply is None:
                             reply = {"ok": False,
                                      "error": f"unknown action {action!r}"}
                         if reply is not REPLY_SENT:
-                            send_msg(conn, reply, registry=reg, version=ver)
+                            send_msg(chan, reply, registry=reg, version=ver,
+                                     count_as=down)
                 except (ConnectionError, OSError) as e:
                     log.warning("reply to %r failed (peer gone?): %s",
                                 action, e)
@@ -552,13 +804,16 @@ class FrameServer:
                     # dropping the peer's connection replyless
                     log.warning("action %r failed: %s", action, e)
                     try:
-                        send_msg(conn, {"ok": False, "error": str(e)},
-                                 registry=reg, version=ver)
+                        send_msg(chan, {"ok": False, "error": str(e)},
+                                 registry=reg, version=ver, count_as=down)
                     except (ConnectionError, OSError):
                         return
                 finally:
                     self._g_inflight.dec()
         finally:
+            if isinstance(chan, ShmChannel):
+                # attachments only: the creating client owns the unlink
+                chan.close_rings()
             try:
                 conn.close()
             except OSError:

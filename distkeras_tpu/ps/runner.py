@@ -586,6 +586,8 @@ def _run_thread_workers(trainer, ps, server, mode, center, xs, ys, num_epoch,
             "127.0.0.1", endpoint, num_epoch, device=dev,
             start_window=start_window, metrics=trainer.metrics,
             comm_codec=getattr(trainer, "comm_codec", "none"),
+            comm_down=getattr(trainer, "comm_down", "none"),
+            shm=getattr(trainer, "ps_shm", False),
             profile_memory=trainer.profile.memory,
             generation=generation, **kw)
         if stream is not None:
@@ -679,6 +681,8 @@ def _run_process_workers(trainer, ps, server, mode, center, xs, ys,
             "aux_weight": float(trainer.aux_weight),
             "mode": mode,
             "comm_codec": getattr(trainer, "comm_codec", "none"),
+            "comm_down": getattr(trainer, "comm_down", "none"),
+            "ps_shm": bool(getattr(trainer, "ps_shm", False)),
             "profile_memory": bool(trainer.profile.memory),
             "alpha": float(getattr(trainer, "alpha", 0.0)),
             "worker_id": k, "host": "127.0.0.1", "port": _endpoint(server),

@@ -35,8 +35,9 @@ from ..obs import COUNT_BUCKETS, TIME_BUCKETS, Registry, StragglerDetector
 from ..obs.spans import SpanTracer
 from ..parallel.sync import _inexact, tmap as _tree_map
 from ..utils import native
+from . import codecs
 from .networking import (REPLY_SENT, WIRE_VERSION, FrameServer, send_packed)
-from .state import DeltaDecoder, LivenessTable, PullCache
+from .state import DeltaDecoder, DownRefState, LivenessTable, PullCache
 
 Tree = Any
 
@@ -355,6 +356,20 @@ class SocketParameterServer(FrameServer):
     cross-process timeline); commits carrying ``gap_s`` feed the
     heartbeat-gap straggler detector, whose ``ps.stragglers`` gauge and
     snapshot ride the ``stats`` reply.
+
+    ISSUE 12 DOWN compression: a pull request carrying a ``down`` map
+    (``{"codec": spec, "ref_epoch": held}``) gets the center as a
+    quantized residual against the shared :class:`~.state.DownRefState`
+    reference — ONE snapshot per ``down_ref_every`` counters, so the
+    reference state stays O(1) per front-end however many connections
+    pull.  An epoch mismatch (first pull, respawned incarnation,
+    reference rolled) serves a full **resync** payload carrying the
+    reference verbatim.  Encoded payloads cache under composite
+    ``(ver, codec, epoch, resync)`` keys — anything that changes the
+    bytes without bumping the counter is in the key, so an adaptive
+    link switching codecs can never be served a stale pre-serialized
+    payload.  Requests without ``down`` (v1 peers, ``comm_down="none"``)
+    take the exact pre-ISSUE-12 raw path, bit-identical on the wire.
     """
 
     metric_prefix = "ps"
@@ -364,7 +379,8 @@ class SocketParameterServer(FrameServer):
                  fault_injector: Optional[Callable[[str, dict], bool]] = None,
                  max_wire_version: int = WIRE_VERSION,
                  tracer: Optional[SpanTracer] = None,
-                 straggler_detector: Optional[StragglerDetector] = None):
+                 straggler_detector: Optional[StragglerDetector] = None,
+                 down_ref_every: int = 64):
         #: front-end instruments live in the PS's registry so one snapshot
         #: covers update rules AND wire traffic
         super().__init__(ps.registry, host=host, port=port,
@@ -390,6 +406,13 @@ class SocketParameterServer(FrameServer):
         self._pull_cache = PullCache(ps.registry)
         self._liveness = LivenessTable()
         self._decode_delta = DeltaDecoder(ps.registry)
+        #: DOWN-compression reference center (ISSUE 12): one shared
+        #: epoch-stamped snapshot per ``down_ref_every`` counters
+        self._down_ref = DownRefState(ps.registry,
+                                      refresh_every=down_ref_every)
+        self._h_down_encode = ps.registry.histogram(
+            "ps.down.encode_seconds", TIME_BUCKETS)
+        self._c_down_resyncs = ps.registry.counter("ps.down.resyncs_served")
         self._c_requests = ps.registry.counter("ps.commit_requests")
         self._c_dropped = ps.registry.counter("ps.commits_dropped")
         self._c_unchanged = ps.registry.counter("ps.pulls_unchanged")
@@ -439,6 +462,58 @@ class SocketParameterServer(FrameServer):
         center, updates = self.ps.pull()
         return center, updates, {}
 
+    def hello_reply(self, msg: dict, ver: int) -> dict:
+        """A DOWN-advertising hello (ISSUE 12) is acked with the codec
+        families this server can encode; v1 connections and plain hellos
+        get the unchanged reply — the advertisement is the client's
+        opt-in, so the default handshake stays byte-identical."""
+        reply = super().hello_reply(msg, ver)
+        if ver >= 2 and isinstance(msg.get("down"), dict):
+            reply["down"] = {"ok": True, "codecs": list(codecs.DOWN_CODECS)}
+        return reply
+
+    def _down_payload(self, msg: dict, ver: int, center, updates: int,
+                      extra: dict):
+        """The pre-serialized reply for a DOWN-compressed pull, or None
+        when this request takes the raw path (no ``down`` map, v1 peer,
+        or the adaptive policy picked "none" for this pull)."""
+        req = msg.get("down") if ver >= 2 else None
+        spec = req.get("codec") if isinstance(req, dict) else None
+        if not spec or spec == "none":
+            return None
+        spec = str(spec)
+        epoch, ref = self._down_ref.for_pull(center, updates)
+        resync = req.get("ref_epoch") is None \
+            or int(req["ref_epoch"]) != epoch
+        if resync:
+            # counted per REQUEST (a cached resync payload still resyncs
+            # the connection it is served to), not per cache build
+            self._c_down_resyncs.inc()
+
+        def build() -> dict:
+            t0 = time.perf_counter()
+            residual = codecs.encode_ref_delta(center, ref, spec)
+            enc = codecs.tree_payload_bytes(residual)
+            down = {"codec": spec, "ref_epoch": epoch, "residual": residual}
+            if resync:
+                # the peer holds no (or a stale) reference: ship it
+                # verbatim next to the residual so this pull decodes
+                # exactly and the connection is synced for the next one
+                down["reference"] = ref
+                enc += codecs.tree_payload_bytes(ref)
+            codecs.count_codec_bytes(self.ps.registry,
+                                     codecs.tree_payload_bytes(center), enc,
+                                     prefix="ps.down")
+            self._h_down_encode.observe(time.perf_counter() - t0)
+            return {"down": down, "updates": updates, **extra}
+
+        # composite key (ISSUE 12): every input to the serialized bytes
+        # besides the counter — codec, reference epoch, resync shape —
+        # so a codec-state change without a counter bump can never be
+        # served a stale pre-serialized payload
+        return self._pull_cache.payload((ver, spec, epoch, resync),
+                                        updates, build, owner=self.ps)
+
     def handle_request(self, action, msg: dict, ver: int,
                        conn: socket.socket):
         """PS protocol body on the shared frame (``hello``/``stop``/
@@ -463,11 +538,16 @@ class SocketParameterServer(FrameServer):
                 if have is not None and int(have) == updates:
                     self._c_unchanged.inc()
                     return {"unchanged": True, "updates": updates, **extra}
-                payload = self._pull_cache.payload(
-                    ver, updates,
-                    lambda: {"center": center, "updates": updates, **extra},
-                    owner=self.ps)
-                send_packed(conn, payload, registry=self.ps.registry)
+                payload = self._down_payload(msg, ver, center, updates,
+                                             extra)
+                if payload is None:
+                    payload = self._pull_cache.payload(
+                        ver, updates,
+                        lambda: {"center": center, "updates": updates,
+                                 **extra},
+                        owner=self.ps)
+                send_packed(conn, payload, registry=self.ps.registry,
+                            count_as=f"{self.metric_prefix}.wire.bytes_down")
                 return REPLY_SENT
         if action == "commit":
             # every commit REQUEST counts before any outcome branches, so

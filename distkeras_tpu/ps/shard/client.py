@@ -91,16 +91,22 @@ class ShardedPSClient:
     ``template`` (any tree with the center's structure — the worker's own
     variables) derives the plan locally; every shard's descriptor is then
     verified against it.  All of ``worker_id`` / ``codec`` /
-    ``wire_version`` / ``tracer`` / ``generation`` mean exactly what they
-    mean on ``PSClient``; the codec SPEC is shared but each shard
-    connection builds its own instance (per-shard error-feedback
-    isolation — one shard's residual never leaks into another's)."""
+    ``wire_version`` / ``tracer`` / ``generation`` / ``down`` / ``shm``
+    mean exactly what they mean on ``PSClient``; the codec SPEC is shared
+    but each shard connection builds its own instance (per-shard
+    error-feedback isolation — one shard's residual never leaks into
+    another's), and likewise each connection owns its own DOWN reference
+    epoch, adaptive policy, and shm rings (ISSUE 12) — a mixed fleet
+    where only SOME shards can attach the rings simply runs those
+    connections on TCP, per-link."""
 
     def __init__(self, addrs: Sequence[Tuple[str, int]], template: Tree,
                  worker_id: int = 0, registry: Optional[Registry] = None,
                  codec=None, wire_version: Optional[int] = None,
                  tracer=None, generation: int = 0, plan_epoch: int = 0,
-                 max_cut_rounds: int = 100):
+                 max_cut_rounds: int = 100, down=None,
+                 shm: Optional[bool] = None,
+                 shm_mb: Optional[float] = None):
         addrs = [(h, int(p)) for h, p in addrs]
         if not addrs:
             raise ValueError("ShardedPSClient needs at least one shard")
@@ -123,7 +129,8 @@ class ShardedPSClient:
                 self.clients.append(PSClient(
                     host, port, worker_id, registry=self.registry,
                     codec=codec, wire_version=wire_version, tracer=tracer,
-                    generation=generation))
+                    generation=generation, down=down, shm=shm,
+                    shm_mb=shm_mb))
             self._verify_plan()
         except BaseException:
             self.close()
@@ -335,6 +342,12 @@ class ShardedPSClient:
             return all(ok)
 
     # -- the rest of the PSClient surface ------------------------------------
+    def invalidate(self) -> None:
+        """Drop every shard connection's center cache (see
+        ``PSClient.invalidate``); DOWN references are kept per-link."""
+        for c in self.clients:
+            c.invalidate()
+
     def stats(self) -> dict:
         """One merged stats document + the per-shard replies (balance
         inspection): counters/histograms sum across shards, ground-truth

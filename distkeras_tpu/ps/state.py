@@ -8,15 +8,26 @@ standalone classes, so a fleet of shard front-ends composes N of each
 (one per shard, each with its own lock and registry) instead of
 N copies of a 500-line server multiplying every concern.
 
-* :class:`PullCache` — pre-serialized pull replies keyed by wire version,
-  built once per commit and served to every puller, with the
-  never-regress rule (a racing handler must not replace a newer center
-  with an older snapshot).  The cache is the **publish point** of the
-  lock-free pull-snapshot contract: once a center tree's buffers are
-  handed to a cached v2 frame, commits must replace — never mutate —
-  those arrays.  :func:`set_publish_hook` lets dklint's runtime
-  racecheck observe every publish and flag write-after-publish
-  violations (ISSUE 10 satellite).
+* :class:`PullCache` — pre-serialized pull replies keyed by **payload
+  shape** — ``(wire version, DOWN codec, ref-epoch, resync)`` — built
+  once per commit and served to every puller, with the never-regress
+  rule (a racing handler must not replace a newer center with an older
+  snapshot).  The composite key closes the ISSUE 12 staleness hole: a
+  codec-state change WITHOUT a counter bump (an adaptive link switching
+  codec, a reference epoch rolling) lands on a different key and can
+  never be served a stale pre-serialized payload.  The cache is the
+  **publish point** of the lock-free pull-snapshot contract: once a
+  center tree's buffers are handed to a cached v2 frame, commits must
+  replace — never mutate — those arrays.  :func:`set_publish_hook` lets
+  dklint's runtime racecheck observe every publish and flag
+  write-after-publish violations (ISSUE 10 satellite).
+* :class:`DownRefState` — the DOWN-compression **reference center**
+  (ISSUE 12): ONE shared snapshot per K counters (not one per
+  connection — a sharded fleet's reference state stays O(shards), and
+  holding a center tree is free because commits replace, never mutate,
+  its arrays), epoch-stamped so a peer holding a stale or absent
+  reference is detected by epoch comparison and resynced with a full
+  reference payload.
 * :class:`LivenessTable` — monotonic last-seen stamps per worker (commit
   AND pull traffic both count) plus the last commit-weight gauge value,
   the supervisor's liveness source.
@@ -56,13 +67,19 @@ def set_publish_hook(hook):
 
 
 class PullCache:
-    """Pre-serialized pull replies: wire version -> ``(updates, payload)``.
+    """Pre-serialized pull replies: payload-shape key -> ``(updates,
+    payload)``.
 
-    The payload is encoded OUTSIDE the cache lock so a slow big-model
-    serialization never serializes concurrent pulls of an already-cached
-    center; the never-regress rule keeps a racing handler from replacing
-    a NEWER cached center with an older snapshot (which would hand a
-    committed worker a pre-commit center on its next pull).
+    ``key`` is any hashable describing every input to the serialized
+    bytes BESIDES the update counter — the wire version alone for raw
+    pulls, ``(ver, codec, ref_epoch, resync)`` for DOWN-compressed ones
+    (ISSUE 12: anything that changes the payload without bumping the
+    counter MUST be in the key, or a stale pre-serialized payload gets
+    served).  The payload is encoded OUTSIDE the cache lock so a slow
+    big-model serialization never serializes concurrent pulls of an
+    already-cached center; the never-regress rule keeps a racing handler
+    from replacing a NEWER cached center with an older snapshot (which
+    would hand a committed worker a pre-commit center on its next pull).
     """
 
     def __init__(self, registry, prefix: str = "ps"):
@@ -70,30 +87,126 @@ class PullCache:
         self._lock = threading.Lock()
         self._c_hits = registry.counter(f"{prefix}.pull_cache_hits")
 
-    def payload(self, ver: int, updates: int, doc_builder: Callable[[], dict],
+    def payload(self, key, updates: int, doc_builder: Callable[[], dict],
                 owner: Any = None):
-        """The cached ``pack_msg`` payload for this (counter, wire
-        version), building (and publishing) it on miss.  ``doc_builder``
+        """The cached ``pack_msg`` payload for this (counter, payload
+        shape), building (and publishing) it on miss.  ``doc_builder``
         returns the reply document — called only when the cache misses,
         so versioned extras (a shard's version vector) are captured
-        exactly once per counter."""
-        with self._lock:
-            ent = self._cache.get(ver)
-            if ent is not None and ent[0] == updates:
-                self._c_hits.inc()
-                return ent[1]
-        doc = doc_builder()
-        payload = pack_msg(doc, version=ver)
+        exactly once per counter.
+
+        Builds are **single-flight per key**: the first miss claims the
+        key (an Event placeholder) and encodes outside the lock; racing
+        pullers of the same (key, counter) wait on the claim and serve
+        the finished payload as a hit — a cold fleet pays ONE multi-MB
+        serialization per payload shape, not one per puller.  Builds for
+        DIFFERENT keys still overlap."""
+        ver = key[0] if isinstance(key, tuple) else key
+        my_evt = None
+        while True:
+            with self._lock:
+                ent = self._cache.get(key)
+                if ent is not None and ent[0] == updates and \
+                        not isinstance(ent[1], threading.Event):
+                    self._c_hits.inc()
+                    return ent[1]
+                if ent is not None and ent[0] == updates:
+                    waiter = ent[1]  # same counter mid-build: wait
+                else:
+                    if ent is None or updates >= ent[0]:
+                        # claim the build (never-regress holds: the
+                        # placeholder carries OUR counter)
+                        my_evt = threading.Event()
+                        self._cache[key] = (updates, my_evt)
+                    # else: an entry NEWER than this capture exists (a
+                    # commit raced the pull) — build this handler's own
+                    # snapshot uncached, claiming would regress
+                    break
+            # the timeout is a liveness backstop only (a builder thread
+            # killed uncleanly); the loop re-reads either way
+            waiter.wait(timeout=30.0)
+        try:
+            doc = doc_builder()
+            payload = pack_msg(doc, version=ver)
+        except BaseException:
+            if my_evt is not None:
+                with self._lock:
+                    cur = self._cache.get(key)
+                    if cur is not None and cur[1] is my_evt:
+                        del self._cache[key]  # waiters re-claim, rebuild
+                    my_evt.set()
+            raise
         hook = _publish_hook
         if hook is not None:
             # the doc's center arrays are now referenced by wire buffers:
-            # this is the publish instant the racecheck contract guards
-            hook(owner, doc.get("center"))
+            # this is the publish instant the racecheck contract guards.
+            # DOWN docs publish their reference tree instead — the one
+            # center-owned buffer set a resync payload shares.
+            down = doc.get("down") or {}
+            hook(owner, doc.get("center", down.get("reference")))
         with self._lock:
-            cur = self._cache.get(ver)
-            if cur is None or updates >= cur[0]:
-                self._cache[ver] = (updates, payload)
+            cur = self._cache.get(key)
+            if cur is None or updates >= cur[0] or cur[1] is my_evt:
+                self._cache[key] = (updates, payload)
+                # prune entries serialized at OLDER counters (stale
+                # wire versions, rolled ref-epochs, retired codecs):
+                # they would miss and rebuild on their next pull anyway,
+                # and each holds a full center payload — without this
+                # the ISSUE 12 composite keys grow the cache per epoch
+                # roll instead of per live payload shape.  In-flight
+                # claims (Events) are left to finish their own insert.
+                stale = [k for k, ent in self._cache.items()
+                         if ent[0] < updates
+                         and not isinstance(ent[1], threading.Event)]
+                for k in stale:
+                    del self._cache[k]
+            if my_evt is not None:
+                # wake OUR waiters under the same hold that made the
+                # payload (or this claim's removal) visible — a woken
+                # racer can never re-read the still-pending placeholder
+                my_evt.set()
         return payload
+
+
+class DownRefState:
+    """The DOWN-compression reference center (ISSUE 12).
+
+    One shared snapshot per ``refresh_every`` counters: rolling the
+    reference is O(1) — commits replace (never mutate) center arrays, so
+    "snapshot" means holding the tree — and every peer decodes against
+    the SAME reference, identified by a monotonically increasing
+    **epoch**.  A pull request declares the epoch its connection holds;
+    a mismatch (first pull, respawned incarnation, epoch rolled, server
+    restarted) serves a **resync** payload carrying the reference
+    verbatim next to the residual, so a stale reference can never decode
+    garbage — the epoch comparison catches it first.
+    """
+
+    def __init__(self, registry, refresh_every: int = 64):
+        if int(refresh_every) < 1:
+            raise ValueError(f"down_ref_every must be >= 1, "
+                             f"got {refresh_every}")
+        self.refresh_every = int(refresh_every)
+        self._epoch = 0
+        self._counter = -1
+        self._tree = None
+        self._lock = threading.Lock()
+        self._g_epoch = registry.gauge("ps.down.ref_epoch")
+
+    def for_pull(self, center, updates: int) -> tuple:
+        """``(epoch, reference_tree)`` for a pull serving ``center`` at
+        counter ``updates`` — rolling the reference to THIS (center,
+        counter) capture when none exists yet or the current one is
+        ``refresh_every`` counters old (residual magnitude, and with it
+        quantization error, grows with reference age)."""
+        with self._lock:
+            if self._tree is None or \
+                    updates - self._counter >= self.refresh_every:
+                self._epoch += 1
+                self._counter = int(updates)
+                self._tree = center
+                self._g_epoch.set(self._epoch)
+            return self._epoch, self._tree
 
 
 class LivenessTable:

@@ -13,6 +13,10 @@ The spec is a msgpack tree (``utils.serde``):
      "worker_optimizer": str, "loss": str, "learning_rate": float,
      "compute_dtype": str|None, "mode": "pull_commit"|"staleness"|"elastic",
      "comm_codec": str (``ps.codecs`` spec, default "none"),
+     "comm_down": str (DOWN pull-compression spec — "none"/"int8"/"bf16"/
+     "topk<frac>"/"adaptive", default "none"; ISSUE 12),
+     "ps_shm": bool (offer the same-host shared-memory transport in the
+     hello — co-located workers skip TCP; default False),
      "alpha": float, "worker_id": int, "host": str, "port": int,
      "num_epoch": int, "seed": int, "data_npz": path, "out_npz": path,
      "metrics_jsonl": path (optional — this process's own telemetry
@@ -92,6 +96,8 @@ def run_spec(spec_path: str) -> None:
         spec["host"], port, int(spec["num_epoch"]),
         start_window=int(spec.get("start_window", 0)),
         comm_codec=spec.get("comm_codec", "none"), metrics=metrics,
+        comm_down=spec.get("comm_down", "none"),
+        shm=bool(spec.get("ps_shm", False)),
         profile_memory=bool(spec.get("profile_memory", True)),
         generation=int(spec.get("gen", 0)), **kw)
     if "stream" in spec:

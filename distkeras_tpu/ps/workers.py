@@ -56,7 +56,8 @@ class AsyncWorker(threading.Thread):
                  host: str, port: int, num_epoch: int,
                  device=None, start_window: int = 0, metrics=None,
                  comm_codec: str = "none", profile_memory: bool = True,
-                 generation: int = 0):
+                 generation: int = 0, comm_down: str = "none",
+                 shm: bool = False):
         super().__init__(name=f"worker-{worker_id}", daemon=True)
         self.worker_id = worker_id
         #: commit generation this incarnation runs under (ISSUE 9): the
@@ -77,6 +78,13 @@ class AsyncWorker(threading.Thread):
         #: delta-compression codec spec (``ps.codecs``): the client built
         #: in ``run()`` owns the stateful error-feedback instance
         self.comm_codec = comm_codec
+        #: DOWN pull-compression spec and same-host shm-transport opt-in
+        #: (ISSUE 12) — like the codec, the client owns the per-link
+        #: state (reference epoch, adaptive policy, rings); a respawned
+        #: incarnation's fresh client starts reference-less, so its
+        #: first pull is a full resync by construction
+        self.comm_down = comm_down
+        self.shm = bool(shm)
         #: optional shared JSONL sink (``MetricsLogger`` — thread-safe):
         #: one ``heartbeat`` record per committed window, so a stalled or
         #: straggling worker is visible IN-RUN, not post-mortem (ISSUE 2)
@@ -132,10 +140,12 @@ class AsyncWorker(threading.Thread):
                 [(self.ps_host, p) for p in self.ps_port],
                 template=_host(self.variables), worker_id=self.worker_id,
                 codec=self.comm_codec, tracer=self.tracer,
-                generation=self.generation)
+                generation=self.generation, down=self.comm_down,
+                shm=self.shm or None)
         return PSClient(self.ps_host, self.ps_port, self.worker_id,
                         codec=self.comm_codec, tracer=self.tracer,
-                        generation=self.generation)
+                        generation=self.generation, down=self.comm_down,
+                        shm=self.shm or None)
 
     def run(self):
         try:

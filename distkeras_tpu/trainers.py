@@ -483,6 +483,8 @@ class DistributedTrainer(Trainer):
                  mode: str = "sync", mesh=None,
                  async_workers: str = "threads",
                  comm_codec: str = "none",
+                 comm_down: str = "none",
+                 ps_shm: bool = False,
                  ps_shards: int = 1,
                  heartbeat_hard_s: float = 30.0,
                  startup_grace_s: float = 300.0, **kw):
@@ -528,7 +530,7 @@ class DistributedTrainer(Trainer):
         #: bit-identical numerics), "int8", "bf16", or "topk<frac>" —
         #: quantized deltas with worker-side error feedback (ISSUE 4).
         #: Sync mode communicates on-device (ICI collectives); no codec.
-        from .ps.codecs import Codec, get_codec
+        from .ps.codecs import Codec, get_codec, validate_down_spec
         if isinstance(comm_codec, Codec):
             # a Codec INSTANCE carries per-worker mutable error-feedback
             # state and cannot be shared by N workers (racing residuals);
@@ -536,6 +538,18 @@ class DistributedTrainer(Trainer):
             comm_codec = comm_codec.name
         get_codec(comm_codec)  # validate the spec at construction time
         self.comm_codec = comm_codec
+        #: async-mode DOWN pull compression (ISSUE 12): "none" (default —
+        #: raw pulls, bit-identical wire), "int8"/"bf16"/"topk<frac>"
+        #: (quantized residuals against the server's shared reference
+        #: center), or "adaptive" (per-link codec chosen from measured
+        #: pull RTTs, with hysteresis and a recorded switch trail)
+        self.comm_down = validate_down_spec(comm_down)
+        #: async-mode same-host shared-memory transport (ISSUE 12): offer
+        #: shm rings in the hello on every PS connection — co-located
+        #: peers (thread-placed fleets; the cluster runner's process-0
+        #: host) skip the kernel socket path, cross-host peers are
+        #: refused at the capability probe and stay on TCP untouched
+        self.ps_shm = bool(ps_shm)
 
     # -- fleet elasticity (ISSUE 9) -----------------------------------------
     def add_worker(self, worker_id=None) -> int:

@@ -185,24 +185,75 @@ def _staleness_lines(hist: dict) -> list:
     return lines
 
 
+def _wire_direction_lines(stats: dict) -> list:
+    """Direction-tagged wire byte split (ISSUE 12): UP (commits/requests)
+    vs DOWN (pulled centers) next to the codec accounting, so DOWN
+    savings are directly observable.  Empty on pre-split snapshots."""
+    up = stats.get("ps.wire.bytes_up", {}).get("value", 0)
+    dn = stats.get("ps.wire.bytes_down", {}).get("value", 0)
+    if not up and not dn:
+        return []
+    shm = stats.get("net.bytes_shm", {}).get("value", 0)
+    line = f"wire bytes: {up:,.0f} up / {dn:,.0f} down"
+    if shm:
+        line += f"   ({shm:,.0f} via shared memory)"
+    return [line]
+
+
 def _codec_lines(stats: dict) -> list:
-    """Commit-codec accounting from a registry snapshot (ISSUE 4): bytes
-    saved, compression ratio, encode/decode latency."""
+    """Codec accounting from a registry snapshot: bytes saved,
+    compression ratio, encode/decode latency per direction — UP commit
+    codecs (ISSUE 4) and DOWN reference-residual pulls with the adaptive
+    switch trail (ISSUE 12) — plus the up/down wire byte split."""
+    lines = []
     raw = stats.get("ps.codec.bytes_raw", {}).get("value", 0)
     enc = stats.get("ps.codec.bytes_encoded", {}).get("value", 0)
-    if not enc:
-        return []
-    saved = stats.get("ps.codec.bytes_saved", {}).get("value", 0)
-    lines = ["== Commit codec ==",
-             f"bytes saved: {saved:,.0f}   compression: {raw / enc:.2f}x "
-             f"({raw:,.0f} raw -> {enc:,.0f} encoded)"]
-    for key, label in (("ps.codec.encode_seconds", "encode"),
-                       ("ps.codec.decode_seconds", "decode")):
-        h = stats.get(key)
-        if h and h.get("count"):
-            lines.append(f"{label:>12}: n={h['count']} mean "
-                         f"{_fmt_seconds(h['sum'] / h['count'])}  p99 "
-                         f"{_fmt_seconds(snapshot_quantile(h, 0.99))}")
+    if enc:
+        saved = stats.get("ps.codec.bytes_saved", {}).get("value", 0)
+        lines += ["== Commit codec (UP) ==",
+                  f"bytes saved: {saved:,.0f}   compression: "
+                  f"{raw / enc:.2f}x "
+                  f"({raw:,.0f} raw -> {enc:,.0f} encoded)"]
+        for key, label in (("ps.codec.encode_seconds", "encode"),
+                           ("ps.codec.decode_seconds", "decode")):
+            h = stats.get(key)
+            if h and h.get("count"):
+                lines.append(f"{label:>12}: n={h['count']} mean "
+                             f"{_fmt_seconds(h['sum'] / h['count'])}  p99 "
+                             f"{_fmt_seconds(snapshot_quantile(h, 0.99))}")
+    draw = stats.get("ps.down.bytes_raw", {}).get("value", 0)
+    denc = stats.get("ps.down.bytes_encoded", {}).get("value", 0)
+    if denc:
+        lines += ["== Pull codec (DOWN, reference-residual) ==",
+                  f"bytes saved: "
+                  f"{stats.get('ps.down.bytes_saved', {}).get('value', 0):,.0f}"
+                  f"   compression: {draw / denc:.2f}x "
+                  f"({draw:,.0f} raw -> {denc:,.0f} encoded)"]
+        detail = []
+        for key, label in (("ps.down.resyncs", "resyncs"),
+                           ("ps.down.resyncs_served", "resyncs served"),
+                           ("ps.codec.switches", "codec switches")):
+            v = stats.get(key, {}).get("value")
+            if v:
+                detail.append(f"{label}: {v:,.0f}")
+        epoch = stats.get("ps.down.ref_epoch", {}).get("value")
+        if epoch is not None:
+            detail.append(f"ref epoch: {epoch:g}")
+        if detail:
+            lines.append("   ".join(detail))
+        for key, label in (("ps.down.encode_seconds", "encode"),
+                           ("ps.down.decode_seconds", "decode")):
+            h = stats.get(key)
+            if h and h.get("count"):
+                lines.append(f"{label:>12}: n={h['count']} mean "
+                             f"{_fmt_seconds(h['sum'] / h['count'])}  p99 "
+                             f"{_fmt_seconds(snapshot_quantile(h, 0.99))}")
+    # the direction split renders even codec-free (raw + shm) runs —
+    # the counters are always tagged once both ends are current
+    wire = _wire_direction_lines(stats)
+    if wire and not lines:
+        lines.append("== Wire directions ==")
+    lines.extend(wire)
     return lines
 
 
@@ -389,7 +440,10 @@ def summarize(records: list) -> str:
                            ("ps.pull_cache_hits", "cache_hits"),
                            ("ps.commits_dropped", "dropped"),
                            ("net.bytes_sent", "bytes_sent"),
-                           ("net.bytes_recv", "bytes_recv")):
+                           ("net.bytes_recv", "bytes_recv"),
+                           ("ps.wire.bytes_up", "bytes_up"),
+                           ("ps.wire.bytes_down", "bytes_down"),
+                           ("net.bytes_shm", "bytes_shm")):
             if key in stats:
                 lines.append(f"{label:>12}: {stats[key]['value']:,.0f}")
         if "ps.apply_seconds" in stats:

@@ -479,6 +479,82 @@ def test_staleness_protocol_tracks_receivers_separately():
 
 
 # ---------------------------------------------------------------------------
+# shm-lifecycle
+# ---------------------------------------------------------------------------
+
+def test_shm_lifecycle_flags_create_without_unlink():
+    found = lint("""
+        from multiprocessing import shared_memory
+
+        def make_ring(size):
+            shm = shared_memory.SharedMemory(create=True, size=size)
+            buf = shm.buf
+            shm.close()   # close alone drops the mapping, NOT the backing
+            return buf
+        """, rule="shm-lifecycle")
+    assert len(found) == 1
+    assert "unlink" in found[0].message
+
+
+def test_shm_lifecycle_clean_with_unlink_on_shutdown_path():
+    found = lint("""
+        from multiprocessing import shared_memory
+
+        class Ring:
+            def __init__(self, size):
+                self.shm = shared_memory.SharedMemory(create=True,
+                                                      size=size)
+
+            def close(self):
+                self.shm.unlink()
+                self.shm.close()
+        """, rule="shm-lifecycle")
+    assert found == []
+
+
+def test_shm_lifecycle_delegated_teardown_counts():
+    # an owner tearing down through a channel helper with unlink=True
+    # (the ShmChannel.close_rings shape) is a valid shutdown path
+    found = lint("""
+        class Client:
+            def connect(self):
+                self.chan = ShmRing.create(1 << 20)
+
+            def close(self):
+                self.chan.close_rings(unlink=True)
+        """, rule="shm-lifecycle")
+    assert found == []
+
+
+def test_shm_lifecycle_attach_side_never_flagged():
+    # attaching to a peer's segment must NOT unlink it — the creator
+    # owns that; attach-only scopes are out of the rule's scope
+    found = lint("""
+        from multiprocessing import shared_memory
+
+        def attach(name):
+            shm = shared_memory.SharedMemory(name=name)
+            try:
+                return bytes(shm.buf)
+            finally:
+                shm.close()
+        """, rule="shm-lifecycle")
+    assert found == []
+
+
+def test_shm_lifecycle_ps_wire_stack_is_clean():
+    """The real shm transport (ISSUE 12) passes its own gate: creator
+    unlinks on the shutdown path, server attachments only close."""
+    from distkeras_tpu.analysis import run_paths
+    from distkeras_tpu.analysis.rules import RULES_BY_ID as rules
+    report = run_paths(
+        [os.path.join(_ROOT, "distkeras_tpu", "ps", "networking.py"),
+         os.path.join(_ROOT, "distkeras_tpu", "ps", "client.py")],
+        rules=[rules["shm-lifecycle"]])
+    assert report.findings == []
+
+
+# ---------------------------------------------------------------------------
 # suppression: inline pragma + baseline round-trip
 # ---------------------------------------------------------------------------
 

@@ -468,6 +468,347 @@ def test_bench_ps_self_check_against_committed_baseline(tmp_path):
     assert (tmp_path / "BENCH_PS_OBS.json").read_text() == "{garbled"
 
 
+# -- ISSUE 12: DOWN compression, adaptive per-link codecs, shm transport -----
+
+def big_tree(n=20_000, seed=0):
+    r = np.random.default_rng(seed)
+    return {"params": [{"w": r.normal(size=n).astype(np.float32)},
+                       {"b": r.normal(size=n // 4).astype(np.float32)}],
+            "state": [{"step": np.int32(7)}, {}]}
+
+
+def test_down_ref_delta_roundtrip(rng):
+    """encode_ref_delta/apply_ref_delta: int8 residual error is bounded
+    by the residual's scale, non-floating leaves pass through verbatim."""
+    ref = big_tree(seed=1)
+    center = big_tree(seed=1)
+    center["params"][0]["w"] = center["params"][0]["w"] \
+        + rng.normal(scale=0.1, size=20_000).astype(np.float32)
+    center["state"][0]["step"] = np.int32(9)
+    enc = codecs.encode_ref_delta(center, ref, "int8")
+    # floating leaves became stubs, the int leaf passed through
+    assert enc["params"][0]["w"]["__dkcodec__"] == "int8"
+    assert enc["state"][0]["step"] == 9
+    dec = codecs.apply_ref_delta(ref, enc)
+    # error bound: scale = max|residual| / 127, round-off <= scale/2
+    bound = float(np.max(np.abs(
+        center["params"][0]["w"] - ref["params"][0]["w"]))) / 127.0
+    np.testing.assert_allclose(dec["params"][0]["w"],
+                               center["params"][0]["w"], atol=bound)
+    # identical leaves (zero residual) reconstruct EXACTLY
+    np.testing.assert_array_equal(dec["params"][1]["b"],
+                                  center["params"][1]["b"])
+    assert dec["state"][0]["step"] == 9
+    # spec validation: unknown and degenerate specs are rejected up
+    # front, identity specs must be spelled "none"
+    with pytest.raises(ValueError, match="comm_codec"):
+        codecs.validate_down_spec("gzip")
+    with pytest.raises(ValueError):
+        codecs.validate_down_spec("topk0")
+    assert codecs.validate_down_spec(None) == "none"
+    assert codecs.validate_down_spec("adaptive") == "adaptive"
+
+
+def test_down_pull_resync_then_residual_cuts_bytes_3x():
+    """The DOWN acceptance shape: first pull is a full reference resync,
+    steady-state pulls ship int8 residuals — >= 3x fewer DOWN wire bytes
+    than raw pulls of the same center."""
+    def measure(down):
+        ps = DeltaParameterServer(big_tree(), num_workers=1)
+        reg = Registry()
+        with SocketParameterServer(ps) as server:
+            with PSClient("127.0.0.1", server.port, registry=reg,
+                          down=down) as c:
+                c.pull()  # cold (resync when down): not the steady state
+                b0 = reg.counter("ps.wire.bytes_down").value
+                for i in range(6):
+                    c.commit({"params": [
+                        {"w": np.full(20_000, 0.01, np.float32)},
+                        {"b": np.full(5_000, 0.01, np.float32)}],
+                        "state": [{"step": np.int32(7)}, {}]})
+                    got, n = c.pull()
+                steady = reg.counter("ps.wire.bytes_down").value - b0
+                return got, steady, reg
+    raw_got, raw_bytes, _ = measure(None)
+    q_got, q_bytes, reg = measure("int8")
+    assert raw_bytes / q_bytes >= 3.0, (raw_bytes, q_bytes)
+    # numerics: residual-decoded center within quantization error of raw
+    np.testing.assert_allclose(q_got["params"][0]["w"],
+                               raw_got["params"][0]["w"], atol=1e-3)
+    assert reg.counter("ps.down.resyncs").value == 1  # cold pull only
+    # the cumulative codec ledger INCLUDES the cold resync's verbatim
+    # reference (honest accounting), so its ratio trails the steady
+    # state; it still shows a clear win and converges to ~4x as the
+    # resync amortizes over the run
+    snap = reg.snapshot()
+    assert snap["ps.down.bytes_raw"]["value"] \
+        / snap["ps.down.bytes_encoded"]["value"] >= 2.0
+
+
+def test_down_v1_interop_matrix():
+    """v1 peers never see the DOWN layer: a v1-pinned client sends no
+    hello (nothing to advertise), a v1-pinned server never acks — both
+    mixes serve raw centers and bit-exact numerics, and shm is never
+    negotiated on a v1 connection."""
+    for pin_client, pin_server in ((1, None), (None, 1), (1, 1)):
+        ps = DeltaParameterServer(tree([0.0]), num_workers=1)
+        kw = {"max_wire_version": 1} if pin_server else {}
+        with SocketParameterServer(ps, **kw) as server:
+            with PSClient("127.0.0.1", server.port,
+                          wire_version=pin_client, down="int8",
+                          shm=True) as c:
+                assert c.wire_version == 1
+                assert not c.down_enabled
+                assert not c.shm_active
+                assert c.commit(tree([2.0]))
+                center, n = c.pull()
+                # raw path: exact, no quantization anywhere
+                np.testing.assert_array_equal(center["params"][0]["w"],
+                                              [2.0])
+        snap = ps.registry.snapshot()
+        assert snap.get("ps.down.bytes_encoded", {}).get("value", 0) == 0
+
+
+def test_pull_cache_codec_state_guard():
+    """ISSUE 12 satellite: a codec-state change WITHOUT a counter bump
+    can never serve a stale pre-serialized payload — the composite key
+    carries codec/ref-epoch/resync, unit-level and through both server
+    paths (plain + shard front-end)."""
+    from distkeras_tpu.ps.state import PullCache
+    builds = []
+
+    def builder(tag):
+        def build():
+            builds.append(tag)
+            return {"center": {"w": np.zeros(4, np.float32)}, "tag": tag}
+        return build
+
+    cache = PullCache(Registry())
+    p_raw = cache.payload(2, 5, builder("raw"))
+    # same counter, different codec state -> different key -> rebuilt
+    p_down = cache.payload((2, "int8", 1, False), 5, builder("int8"))
+    assert builds == ["raw", "int8"]
+    assert p_raw is not p_down
+    # same key again -> cached, NOT rebuilt
+    assert cache.payload((2, "int8", 1, False), 5, builder("int8")) \
+        is p_down
+    # epoch roll without counter bump -> new key -> rebuilt
+    cache.payload((2, "int8", 2, True), 5, builder("resync"))
+    assert builds == ["raw", "int8", "resync"]
+
+    # end to end, plain server: a raw puller and a down puller at the
+    # SAME update counter must get different payload shapes
+    ps = DeltaParameterServer(tree([3.0]), num_workers=2)
+    with SocketParameterServer(ps) as server:
+        with PSClient("127.0.0.1", server.port, 0) as raw_c, \
+                PSClient("127.0.0.1", server.port, 1,
+                         down="int8") as down_c:
+            r, _ = raw_c.pull()
+            d, _ = down_c.pull()
+            np.testing.assert_array_equal(r["params"][0]["w"], [3.0])
+            np.testing.assert_allclose(d["params"][0]["w"], [3.0],
+                                       atol=1e-4)
+            assert down_c._down_ref is not None  # decoded via reference
+
+    # and through the shard front-end (its _pull_state override rides
+    # the same cache protocol)
+    from distkeras_tpu.ps.shard import ShardedParameterServer
+    center = big_tree(n=64)
+    with ShardedParameterServer(center, 2, DeltaParameterServer,
+                                num_workers=2) as fleet:
+        from distkeras_tpu.ps.shard import ShardedPSClient
+        with ShardedPSClient(fleet.addrs(), center, 0) as raw_c, \
+                ShardedPSClient(fleet.addrs(), center, 1,
+                                down="int8") as down_c:
+            r, _ = raw_c.pull()
+            d, _ = down_c.pull()
+            np.testing.assert_allclose(
+                d["params"][0]["w"], r["params"][0]["w"], atol=1e-3)
+            assert all(c._down_ref is not None for c in down_c.clients)
+
+
+def test_adaptive_down_policy_hysteresis_and_trail():
+    """AdaptiveDownPolicy: warmup samples every candidate, a challenger
+    must beat the incumbent by the margin on `patience` consecutive
+    evaluations (one switch, recorded), and RTT noise never flaps."""
+    reg = Registry()
+    pol = codecs.AdaptiveDownPolicy(reg, candidates=("none", "int8"),
+                                    margin=0.2, patience=3,
+                                    warmup_samples=2, reprobe_every=0)
+    # warmup: the pull loop asks, pulls, observes — the policy walks
+    # every candidate to warmup_samples before serving an incumbent
+    seen = []
+    for _ in range(4):
+        c = pol.next_codec()
+        seen.append(c)
+        pol.observe(c, 0.010 if c == "none" else 0.002)
+    assert seen.count("none") == 2 and seen.count("int8") == 2
+    # int8 is 5x better: patience evaluations then ONE switch
+    for _ in range(3):
+        pol.observe("int8", 0.002)
+    assert pol.current == "int8"
+    assert reg.counter("ps.codec.switches").value == 1
+    assert len(pol.trail) == 1
+    assert pol.trail[0]["from"] == "none" and pol.trail[0]["to"] == "int8"
+    # noise within the margin: no flapping back
+    for _ in range(20):
+        pol.observe("int8", 0.0021)
+        pol.observe("none", 0.0022)
+    assert pol.current == "int8"
+    assert reg.counter("ps.codec.switches").value == 1
+    # junk observations are ignored, not folded into the EWMAs
+    pol.observe("int8", float("nan"))
+    pol.observe("bogus", 0.001)
+    assert pol.current == "int8"
+
+
+def test_adaptive_down_end_to_end():
+    """down='adaptive' drives real pulls: warmup cycles every candidate
+    codec against the live link, every pull decodes exactly (within
+    quantization error), and the policy's EWMAs get seeded."""
+    ps = DeltaParameterServer(big_tree(), num_workers=1)
+    reg = Registry()
+    with SocketParameterServer(ps) as server:
+        with PSClient("127.0.0.1", server.port, registry=reg,
+                      down="adaptive") as c:
+            assert c.down_enabled and c._down_policy is not None
+            ref = None
+            for i in range(8):
+                c.commit({"params": [
+                    {"w": np.full(20_000, 0.01, np.float32)},
+                    {"b": np.full(5_000, 0.01, np.float32)}],
+                    "state": [{"step": np.int32(7)}, {}]})
+                got, n = c.pull()
+            pol = c._down_policy
+            assert all(pol._samples[cand] >= pol.warmup_samples
+                       for cand in pol.candidates if cand != "none"), \
+                pol._samples
+    expect = np.asarray(ps.center["params"][0]["w"])
+    np.testing.assert_allclose(got["params"][0]["w"], expect, atol=1e-2)
+
+
+def test_shm_negotiation_transport_and_cleanup():
+    """shm=True against a same-host server: rings negotiated, tensor
+    segments bypass TCP (net.bytes_shm), numerics exact, and the
+    client-owned segments are unlinked from /dev/shm on close."""
+    ps = DeltaParameterServer(big_tree(), num_workers=1)
+    reg = Registry()
+    with SocketParameterServer(ps) as server:
+        c = PSClient("127.0.0.1", server.port, registry=reg, shm=True)
+        try:
+            assert c.shm_active
+            names = [c._chan.tx.name.strip("/"), c._chan.rx.name.strip("/")]
+            got, _ = c.pull()
+            np.testing.assert_array_equal(
+                got["params"][0]["w"], np.asarray(ps.center["params"][0]["w"]))
+            c.commit({"params": [{"w": np.ones(20_000, np.float32)},
+                                 {"b": np.ones(5_000, np.float32)}],
+                      "state": [{"step": np.int32(7)}, {}]})
+            got2, n2 = c.pull()
+            assert n2 == 1
+            np.testing.assert_allclose(
+                got2["params"][0]["w"],
+                np.asarray(ps.center["params"][0]["w"]))
+            assert reg.counter("net.bytes_shm").value > 0
+        finally:
+            c.close()
+        if os.path.isdir("/dev/shm"):
+            leftovers = [n for n in names
+                         if os.path.exists(os.path.join("/dev/shm", n))]
+            assert not leftovers, leftovers
+
+
+def test_shm_oversized_message_falls_back_to_tcp():
+    """A message whose segments exceed the ring transparently rides the
+    TCP frame for that message — correctness never depends on capacity."""
+    n = 600_000  # 2.4 MB center vs the 1 MB minimum ring
+    center = {"params": [{"w": np.arange(n, dtype=np.float32)}],
+              "state": [{}]}
+    ps = DeltaParameterServer(center, num_workers=1)
+    reg = Registry()
+    with SocketParameterServer(ps) as server:
+        with PSClient("127.0.0.1", server.port, registry=reg, shm=True,
+                      shm_mb=1.0) as c:
+            assert c.shm_active
+            got, _ = c.pull()  # 2.4 MB does not fit: TCP fallback
+            np.testing.assert_array_equal(got["params"][0]["w"],
+                                          center["params"][0]["w"])
+            c.commit({"params": [{"w": np.zeros(n, np.float32)}],
+                      "state": [{}]})
+    # the big center payload was NOT shm-carried
+    assert reg.counter("net.bytes_shm").value < n * 4
+
+
+def test_killed_worker_respawn_resyncs_reference_and_tombstones():
+    """ISSUE 12 satellite: a worker killed mid-run (connection torn, no
+    teardown) and respawned starts reference-less — its first pull is a
+    full resync — while the zombie's stale-generation commit tombstones
+    with exact accounting."""
+    ps = DeltaParameterServer(big_tree(), num_workers=1)
+    with SocketParameterServer(ps) as server:
+        reg1 = Registry()
+        zombie = PSClient("127.0.0.1", server.port, worker_id=0,
+                          registry=reg1, down="int8", generation=0)
+        zombie.pull()
+        zombie.commit({"params": [{"w": np.ones(20_000, np.float32)},
+                                  {"b": np.ones(5_000, np.float32)}],
+                       "state": [{"step": np.int32(7)}, {}]})
+        assert reg1.counter("ps.down.resyncs").value == 1
+        # the supervisor declares the incarnation dead (kill -9 has no
+        # goodbye): generation bumps, socket just drops
+        window = ps.evict_worker(0)
+        assert window == 1
+        # the respawned incarnation: a FRESH client under the bumped
+        # generation — reference-less by construction
+        start, gen = ps.register_respawn(0)
+        assert (start, gen) == (1, 1)
+        reg2 = Registry()
+        with PSClient("127.0.0.1", server.port, worker_id=0,
+                      registry=reg2, down="int8", generation=gen) as fresh:
+            got, n = fresh.pull()
+            assert reg2.counter("ps.down.resyncs").value == 1
+            np.testing.assert_allclose(
+                got["params"][0]["w"],
+                np.asarray(ps.center["params"][0]["w"]), atol=1e-3)
+            # the zombie wakes up (SIGCONT) and replays its commit: the
+            # stale generation tombstones — never applied, exact books
+            from distkeras_tpu.ps.client import WorkerEvicted
+            with pytest.raises(WorkerEvicted):
+                zombie.commit({"params": [
+                    {"w": np.ones(20_000, np.float32)},
+                    {"b": np.ones(5_000, np.float32)}],
+                    "state": [{"step": np.int32(7)}, {}]})
+            assert ps.tombstoned_by_worker == {0: 1}
+            assert ps.commits_by_worker == {0: 1}
+            assert ps.registry.get("ps.commits_tombstoned").value == 1
+            # a fresh-generation commit lands normally
+            fresh.commit({"params": [{"w": np.ones(20_000, np.float32)},
+                                     {"b": np.ones(5_000, np.float32)}],
+                          "state": [{"step": np.int32(7)}, {}]})
+            assert ps.commits_by_worker == {0: 2}
+        zombie.close()
+
+
+def test_reconnect_resets_down_reference():
+    """A reconnect (server restart, mid-pull connection loss) drops the
+    held reference: the revenant connection's next pull resyncs instead
+    of decoding against state the server may no longer have."""
+    ps = DeltaParameterServer(big_tree(), num_workers=1)
+    reg = Registry()
+    with SocketParameterServer(ps) as server:
+        with PSClient("127.0.0.1", server.port, registry=reg,
+                      down="int8") as c:
+            c.pull()
+            assert c._down_ref is not None
+            c.reconnect()
+            assert c._down_ref is None  # reference-less again
+            got, _ = c.pull()           # full resync, decodes exactly
+            assert reg.counter("ps.down.resyncs").value == 2
+            np.testing.assert_allclose(
+                got["params"][0]["w"],
+                np.asarray(ps.center["params"][0]["w"]), atol=1e-3)
+
+
 def test_obsview_prints_codec_accounting(tmp_path):
     sys.path.insert(0, os.path.join(ROOT, "scripts"))
     try:

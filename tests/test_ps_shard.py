@@ -487,6 +487,101 @@ def test_sharded_dynsgd_converges_with_zero_retraces(ds):
     assert reg.counter("jit.retraces").value == 0
 
 
+# -- ISSUE 12: DOWN compression + shm across a shard fleet -------------------
+
+def test_sharded_down_pulls_resync_per_link():
+    """DOWN compression over a shard fleet: every shard connection owns
+    its own reference epoch (one resync per link), assembled centers
+    match raw pulls within quantization error, and the DOWN ledger shows
+    the reduction."""
+    center = center_tree()
+    with ShardedParameterServer(center, 2, DeltaParameterServer,
+                                num_workers=2) as fleet:
+        reg = Registry()
+        with ShardedPSClient(fleet.addrs(), center, 0, registry=reg,
+                             down="int8") as down_c, \
+                ShardedPSClient(fleet.addrs(), center, 1) as raw_c:
+            down_c.pull()
+            assert reg.counter("ps.down.resyncs").value == 2  # per link
+            raw_c.commit(ones_like_center(v=0.5))
+            got_raw, n_raw = raw_c.pull()
+            got_down, n_down = down_c.pull()
+            assert n_raw == n_down
+            for a, b in zip(got_down["params"], got_raw["params"]):
+                np.testing.assert_allclose(a["w"], b["w"], atol=1e-3)
+            # still one resync per link: steady state is residual-only
+            assert reg.counter("ps.down.resyncs").value == 2
+            snap = reg.snapshot()
+            assert snap["ps.down.bytes_raw"]["value"] > \
+                snap["ps.down.bytes_encoded"]["value"]
+
+
+def test_mixed_fleet_partial_shm_negotiation():
+    """ISSUE 12 satellite: a fleet where only SOME shards can negotiate
+    shm (here one shard is v1-pinned — legacy build emulation) runs the
+    shm links on the ring and the refused links on TCP, with DOWN active
+    only where acked; pulls still assemble exactly."""
+    from distkeras_tpu.ps.shard.server import ShardFrontend
+    center = center_tree()
+    plan = ShardPlan.build(center, 2)
+    slices = plan.split(center)
+    shards = [DeltaParameterServer(s, num_workers=1) for s in slices]
+    servers = [ShardFrontend(shards[0], plan, 0),
+               ShardFrontend(shards[1], plan, 1, max_wire_version=1)]
+    for s in servers:
+        s.start()
+    try:
+        addrs = [("127.0.0.1", s.port) for s in servers]
+        reg = Registry()
+        with ShardedPSClient(addrs, center, 0, registry=reg,
+                             down="int8", shm=True) as c:
+            assert c.clients[0].shm_active and c.clients[0].down_enabled
+            assert not c.clients[1].shm_active
+            assert not c.clients[1].down_enabled  # v1: raw, no rings
+            assert c.wire_version == 1  # fleet minimum, as negotiated
+            c.commit(ones_like_center(v=1.0))
+            got, n = c.pull()
+            assert n == 2  # one logical commit, once per shard
+            for leaf, ref in zip(got["params"],
+                                 ones_like_center(v=1.0)["params"]):
+                np.testing.assert_allclose(leaf["w"], ref["w"], atol=1e-3)
+            assert reg.counter("net.bytes_shm").value > 0
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_sharded_dynsgd_converges_with_down_and_shm(ds):
+    """ISSUE 12 acceptance: async DynSGD over a sharded fleet with int8
+    DOWN compression AND the shm transport converges at the existing
+    gate with ``jit.retraces == 0`` — the full wire-round-2 stack under
+    the tier-1 workload."""
+    from distkeras_tpu.obs import drift
+    from distkeras_tpu.obs.registry import Registry as _Registry
+
+    t = dk.DynSGD(make_model(), "sgd", num_workers=2, mode="async",
+                  communication_window=4, ps_shards=2, comm_down="int8",
+                  ps_shm=True, **COMMON)
+    reg = _Registry()
+    t.tracer.registry = reg
+    m = t.train(ds)
+    acc = accuracy(m, ds)
+    assert acc > 0.85, acc
+    snap = t.ps_stats["registry"]
+    # the DOWN ledger and the direction split made it into the stats
+    assert snap["ps.down.bytes_raw"]["value"] > \
+        snap["ps.down.bytes_encoded"]["value"]
+    assert snap["ps.wire.bytes_down"]["value"] > 0
+    assert snap["ps.wire.bytes_up"]["value"] > 0
+    assert snap["net.bytes_shm"]["value"] > 0  # co-located: rings used
+    reg.counter("jit.retraces")
+    assert reg.counter("jit.retraces").value == 0
+    bl = drift.load_baseline(os.path.join(ROOT, "OBS_BASELINE.json"))
+    doc = {"config": {"shards": 2, "down": "int8"}, "trainer": reg.snapshot()}
+    rep = drift.diff_docs(doc, doc, baseline=bl)
+    assert not rep.drifted
+
+
 # -- racecheck: write-after-publish (ISSUE 10 satellite) ---------------------
 
 def test_racecheck_clean_on_sharded_traffic():
