@@ -35,11 +35,11 @@ so runs can diff distributions, not just wall numbers.
 
 ``python bench.py --serve [--requests N] [--concurrency C]
 [--prompt-len P] [--max-new K] [--slots B] [--queue Q] [--spec K]
-[--no-prefix]`` runs the **decode-service load bench** (ISSUE 7): a
-localhost continuous-batching ``ServeServer`` over a small gpt_lm,
-driven by C closed-loop client threads, printing one JSON row with
-p50/p99 end-to-end + time-to-first-token latency, tokens/sec and the
-load-shed count, and persisting the service registry snapshot (SLO
+[--no-prefix] [--engines N]`` runs the **decode-service load bench**
+(ISSUE 7): a localhost continuous-batching ``ServeServer`` over a small
+gpt_lm, driven by C closed-loop client threads, printing one JSON row
+with p50/p99 end-to-end + time-to-first-token latency, tokens/sec and
+the load-shed count, and persisting the service registry snapshot (SLO
 histograms + admission counters + the zero-pinned ``jit.retraces``
 sentinel) to ``BENCH_SERVE_OBS.json``.  ISSUE 11 folds the two decode
 accelerators into the same row + snapshot: a warm-vs-cold **prefix
@@ -47,6 +47,11 @@ phase** (ttft p50 with a shared cached prefix vs a cold prefill) and a
 **spec phase** (tokens/sec with and without speculative decoding, at
 exact greedy parity vs ``generate_tokens``) — both drift-gated, so a
 hit-rate or accept-rate regression fails like any perf regression.
+ISSUE 14 adds the **router phase** (``--engines N``): the
+``ServeRouter`` fleet scaling sweep — aggregate tokens/sec + client
+p99 e2e vs fleet size over a shared-prefix workload with
+prefix-affinity routing, one merged fleet snapshot per point
+(``router_n<n>``), same drift gate.
 
 All benches self-check against the committed baseline snapshot named in
 ``OBS_BASELINE.json`` (ISSUE 5): the fresh run's registry snapshot is
@@ -310,6 +315,25 @@ SERVE_SPEC_PHASE = dict(k=4, requests=8, prompt_len=8, max_new=32,
                         vocab=64, dim=32, heads=2, blocks=1, seq_len=64,
                         slots=2)
 
+#: committed config of the router scaling phase (ISSUE 14): an N-engine
+#: fleet behind one ``ServeRouter``, swept n = 1..engines over a
+#: shared-prefix workload.  Sized so the fleet actually scales on a CPU
+#: host: the decode step must be COMPUTE-bound (dim 256 — a
+#: dispatch-bound toy step lets one engine's continuous batching absorb
+#: any concurrency, and splitting it across engines only adds hops) and
+#: the offered concurrency must OVERSUBSCRIBE a single engine's slots
+#: (concurrency 12 vs slots 2: one engine runs at occupancy 2, the
+#: 3-engine fleet at 6) — that gap is exactly what the front door
+#: exists to harvest.  The cold pass is SERIALIZED (one request per
+#: group) so affinity registration and every prefix counter are
+#: deterministic under the drift gate's exact serve.prefix.* rule; the
+#: storm that follows is all warm, affinity-routed traffic.
+SERVE_ROUTER_PHASE = dict(engines=3, groups=12, per_group=5,
+                          concurrency=12, shared=48, tail=6, max_new=16,
+                          block=16, slots=2, queue=256, cache_mb=64.0,
+                          vocab=256, dim=256, heads=4, blocks=2,
+                          seq_len=128)
+
 
 def _serve_prefix_phase(phase: dict):
     """The warm-vs-cold ttft probe: serialized requests sharing a long
@@ -318,7 +342,7 @@ def _serve_prefix_phase(phase: dict):
     Returns the row fields + the engine registry snapshot (the
     ``serve.ttft_{warm,cold}_seconds`` split and ``serve.prefix.*``
     counters live there)."""
-    from distkeras_tpu.obs import Registry, snapshot_quantile
+    from distkeras_tpu.obs import Registry
     from distkeras_tpu.serve import DecodeEngine, ServeConfig
 
     model = zoo.gpt_lm(vocab_size=phase["vocab"], dim=phase["dim"],
@@ -337,17 +361,27 @@ def _serve_prefix_phase(phase: dict):
     rng = np.random.default_rng(11)
     shared = rng.integers(0, phase["vocab"],
                           size=(phase["shared"],)).astype(np.int32)
+    done = []
     with engine:
         for _ in range(phase["requests"]):
             tail = rng.integers(0, phase["vocab"],
                                 size=(phase["tail"],)).astype(np.int32)
             # serialized: each request completes before the next is
             # submitted, so warm/cold attribution is deterministic
-            engine.submit(np.concatenate([shared, tail]),
-                          phase["max_new"]).result(timeout=600)
+            req = engine.submit(np.concatenate([shared, tail]),
+                                phase["max_new"])
+            req.result(timeout=600)
+            done.append(req)
     snap = registry.snapshot()
-    warm = snapshot_quantile(snap["serve.ttft_warm_seconds"], 0.5)
-    cold = snapshot_quantile(snap["serve.ttft_cold_seconds"], 0.5)
+    # the ROW p50s come from the exact per-request timestamps (the
+    # requests are driven right here) — the histogram quantile would
+    # interpolate a handful of observations across coarse bucket
+    # bounds, quantizing warm_speedup run to run; the histograms still
+    # ride in the snapshot for the drift gate's distribution check
+    warm = float(np.median([r.first_token_t - r.submit_t
+                            for r in done if r.warm]))
+    cold = float(np.median([r.first_token_t - r.submit_t
+                            for r in done if r.warm is False]))
     hits = snap["serve.prefix.hits"]["value"]
     misses = snap["serve.prefix.misses"]["value"]
     fields = {
@@ -420,12 +454,149 @@ def _serve_spec_phase(phase: dict):
     return fields, snap_base, snap_spec
 
 
+def _serve_router_phase(phase: dict):
+    """The ISSUE 14 fleet scaling sweep: for each fleet size
+    n = 1..engines, build n prefix-cached engines behind one
+    ``ServeRouter`` and drive the SAME shared-prefix workload through
+    the front door — a serialized cold pass (one request per group:
+    registers affinity, populates each engine's prefix cache,
+    deterministic counters) followed by a concurrent closed-loop storm
+    of the remaining warm requests.  Returns the row fields (the
+    scaling curve: tokens/sec, client p99 e2e, prefix/affinity hit
+    rates per n) plus one MERGED fleet registry snapshot per point
+    (``router_n<n>`` — router + every engine, the
+    ``Registry.merge_snapshots`` SLO view) for the drift gate."""
+    import threading
+
+    from distkeras_tpu.serve import (DecodeEngine, RouterConfig,
+                                     ServeClient, ServeConfig,
+                                     ServeRouter, ServeServer)
+    from distkeras_tpu.obs import Registry
+
+    model = zoo.gpt_lm(vocab_size=phase["vocab"], dim=phase["dim"],
+                       num_heads=phase["heads"],
+                       num_blocks=phase["blocks"],
+                       seq_len=phase["seq_len"])
+    variables = model.init(0)
+    rng = np.random.default_rng(13)
+    groups, per_group = int(phase["groups"]), int(phase["per_group"])
+    conc = int(phase["concurrency"])
+    max_new, block = int(phase["max_new"]), int(phase["block"])
+    gshared = [rng.integers(0, phase["vocab"],
+                            size=(phase["shared"],)).astype(np.int32)
+               for _ in range(groups)]
+    tails = [[rng.integers(0, phase["vocab"],
+                           size=(phase["tail"],)).astype(np.int32)
+              for _ in range(per_group)] for _ in range(groups)]
+
+    scaling, parts = [], {}
+    for n in range(1, int(phase["engines"]) + 1):
+        servers = []
+        router = None
+        try:
+            for _ in range(n):
+                cfg = ServeConfig(
+                    slots=phase["slots"], max_queue=phase["queue"],
+                    max_new_tokens=max_new,
+                    prefill_buckets=(block * 2, phase["seq_len"]),
+                    prefix_cache=True, prefix_cache_mb=phase["cache_mb"],
+                    prefix_block=block)
+                eng = DecodeEngine(model, variables, cfg,
+                                   registry=Registry()).warmup()
+                servers.append(ServeServer(eng).start())
+            router = ServeRouter(
+                [("127.0.0.1", s.port) for s in servers],
+                config=RouterConfig(affinity_block=block,
+                                    stats_interval_s=0.2)).start()
+            with ServeClient("127.0.0.1", router.port) as client:
+                for g in range(groups):
+                    reply = client.generate(
+                        np.concatenate([gshared[g], tails[g][0]]),
+                        max_new)
+                    if not reply.get("ok"):
+                        raise RuntimeError(
+                            f"router cold pass failed: {reply}")
+            work = [(g, i) for g in range(groups)
+                    for i in range(1, per_group)]
+            shares = [work[k::conc] for k in range(conc)]
+            e2e = [[] for _ in range(conc)]
+            errors: list = []
+
+            def drive(k: int) -> None:
+                try:
+                    with ServeClient("127.0.0.1",
+                                     router.port) as client:
+                        for g, i in shares[k]:
+                            t0 = time.perf_counter()
+                            reply = client.generate(
+                                np.concatenate([gshared[g],
+                                                tails[g][i]]), max_new)
+                            if not reply.get("ok"):
+                                raise RuntimeError(
+                                    f"router storm failed: {reply}")
+                            e2e[k].append(time.perf_counter() - t0)
+                except BaseException as e:
+                    errors.append(e)
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=drive, args=(k,),
+                                        name=f"bench-router-{k}")
+                       for k in range(conc)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            if errors:
+                raise errors[0]
+            with ServeClient("127.0.0.1", router.port) as client:
+                reply = client.stats()
+        finally:
+            if router is not None:
+                router.stop()
+            for s in servers:
+                s.stop()
+        merged = reply["stats"]
+
+        def _v(name):
+            return merged.get(name, {}).get("value", 0)
+
+        hits, misses = _v("serve.prefix.hits"), _v("serve.prefix.misses")
+        all_e2e = np.asarray(sorted(v for part in e2e for v in part))
+        routed_aff = _v("serve.router.affinity_hits")
+        routed = routed_aff + _v("serve.router.affinity_misses")
+        scaling.append({
+            "engines": n,
+            "tokens_per_sec": round(len(work) * max_new / wall, 1),
+            "e2e_ms_p99": round(
+                float(np.quantile(all_e2e, 0.99)) * 1e3, 3),
+            "prefix_hit_rate": round(hits / (hits + misses), 3)
+            if hits + misses else 0.0,
+            "affinity_route_share": round(routed_aff / routed, 3)
+            if routed else 0.0,
+            "per_engine_requests": [e.get("requests")
+                                    for e in reply.get("engines", [])],
+            "requeues": _v("serve.router.requeues"),
+            "evictions": _v("serve.router.evictions"),
+            "jit_retraces": _v("jit.retraces"),
+        })
+        parts[f"router_n{n}"] = merged
+    fields = {
+        "router_engines": int(phase["engines"]),
+        "router_scaling": scaling,
+        "router_speedup": round(scaling[-1]["tokens_per_sec"]
+                                / scaling[0]["tokens_per_sec"], 2),
+        "router_affinity_hit_rate": scaling[-1]["prefix_hit_rate"],
+    }
+    return fields, parts
+
+
 def bench_serve(requests: int = 32, concurrency: int = 4,
                 prompt_len: int = 12, max_new: int = 16, slots: int = 4,
                 queue: int = 8, out_dir: str = ROOT, wire_version=None,
                 vocab: int = 64, dim: int = 32, heads: int = 2,
                 blocks: int = 1, seq_len: int = 64, prefix_phase=None,
-                spec_phase=None) -> dict:
+                spec_phase=None, router_phase=None) -> dict:
     """Decode-service load bench (ISSUE 7 acceptance): a localhost
     ``ServeServer`` over a small ``gpt_lm`` and ``concurrency``
     closed-loop client threads driving ``requests`` generations through
@@ -453,6 +624,14 @@ def bench_serve(requests: int = 32, concurrency: int = 4,
       (``tokens_per_sec_base`` / ``tokens_per_sec_spec`` /
       ``spec_uplift`` / ``spec_accept_rate`` / ``spec_parity``;
       snapshot parts ``"spec_base"`` / ``"spec"``).
+
+    ISSUE 14 adds the **router phase** (``SERVE_ROUTER_PHASE``
+    overrides; the ``bench.py --serve --engines N`` entry point): the
+    N-engine fleet scaling sweep behind one ``ServeRouter`` —
+    ``router_scaling`` (tokens/sec + client p99 e2e + prefix/affinity
+    hit rates per fleet size), ``router_speedup`` (n=max vs n=1),
+    ``router_affinity_hit_rate``; one merged fleet snapshot part
+    ``router_n<n>`` per point.
 
     Both phases' registry snapshots ride in the SAME drift-gated
     ``BENCH_SERVE_OBS.json``, so a future hit-rate or accept-rate
@@ -558,11 +737,14 @@ def bench_serve(requests: int = 32, concurrency: int = 4,
         else {**SERVE_PREFIX_PHASE, **(prefix_phase or {})}
     spec_cfg = None if spec_phase is False \
         else {**SERVE_SPEC_PHASE, **(spec_phase or {})}
+    router_cfg = None if router_phase is False \
+        else {**SERVE_ROUTER_PHASE, **(router_phase or {})}
     row.update(dict.fromkeys(
         ("ttft_warm_ms_p50", "ttft_cold_ms_p50", "warm_speedup",
          "prefix_hit_rate", "spec_k", "tokens_per_sec_base",
          "tokens_per_sec_spec", "spec_uplift", "spec_accept_rate",
-         "spec_parity")))
+         "spec_parity", "router_engines", "router_scaling",
+         "router_speedup", "router_affinity_hit_rate")))
     parts = {}
     if prefix_cfg is not None:
         fields, parts["prefix"] = _serve_prefix_phase(prefix_cfg)
@@ -571,6 +753,10 @@ def bench_serve(requests: int = 32, concurrency: int = 4,
         fields, parts["spec_base"], parts["spec"] = \
             _serve_spec_phase(spec_cfg)
         row.update(fields)
+    if router_cfg is not None:
+        fields, router_parts = _serve_router_phase(router_cfg)
+        row.update(fields)
+        parts.update(router_parts)
 
     bl_cfg = _baseline_cfg()
     base_path = _baseline_snapshot_path(bl_cfg, "serve_bench",
@@ -585,6 +771,7 @@ def bench_serve(requests: int = 32, concurrency: int = 4,
                                     "seq_len": seq_len},
                           "prefix_phase": prefix_cfg,
                           "spec_phase": spec_cfg,
+                          "router_phase": router_cfg,
                           **cfg.config_row(seq_len)},
                # the wall-clock row rides in the committed artifact too:
                # the acceptance numbers (warm_speedup, spec_uplift,
@@ -1046,6 +1233,11 @@ def _cli(argv=None) -> int:
     ap.add_argument("--no-prefix", action="store_true",
                     help="bench_serve: skip the warm-vs-cold prefix "
                          "phase")
+    ap.add_argument("--engines", type=int, default=None, metavar="N",
+                    help="bench_serve: sweep the ServeRouter fleet "
+                         "scaling phase over 1..N engines (ISSUE 14; "
+                         "default: the committed SERVE_ROUTER_PHASE "
+                         "fleet of 3; 0 skips the phase)")
     ap.add_argument("--codec", default="none",
                     help="bench_ps commit codec: none|int8|bf16|topk<frac>")
     ap.add_argument("--down", default="none",
@@ -1096,6 +1288,8 @@ def _cli(argv=None) -> int:
             ap.error("--requests and --concurrency must be >= 1")
         if args.spec is not None and args.spec < 0:
             ap.error("--spec must be >= 0 (0 skips the spec phase)")
+        if args.engines is not None and args.engines < 0:
+            ap.error("--engines must be >= 0 (0 skips the router phase)")
         print(json.dumps(bench_serve(
             requests=args.requests, concurrency=args.concurrency,
             prompt_len=args.prompt_len, max_new=args.max_new,
@@ -1103,7 +1297,10 @@ def _cli(argv=None) -> int:
             wire_version=args.wire,
             prefix_phase=False if args.no_prefix else None,
             spec_phase=False if args.spec == 0
-            else None if args.spec is None else {"k": args.spec})))
+            else None if args.spec is None else {"k": args.spec},
+            router_phase=False if args.engines == 0
+            else None if args.engines is None
+            else {"engines": args.engines})))
         return 0
     if args.ps:
         try:

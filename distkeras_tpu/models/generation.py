@@ -159,6 +159,60 @@ def _filter_logits(logits, top_k, top_p):
     return logits
 
 
+def filter_logits_rowwise(logits, top_k, top_p):
+    """Per-row top-k / nucleus filtering with TRACED (B,) parameters —
+    the per-request sampling primitive (ISSUE 14): unlike
+    :func:`_filter_logits`, whose knobs are Python constants baked into
+    the trace, these ride as device arrays, so ONE compiled program
+    serves every sampling configuration without re-tracing.
+    ``top_k[r] == 0`` disables top-k for row r; ``top_p[r] >= 1``
+    disables nucleus filtering.  ``logits`` is (B, V)."""
+    v = logits.shape[-1]
+    top_k = jnp.asarray(top_k, jnp.int32)
+    top_p = jnp.asarray(top_p, logits.dtype)
+    sorted_desc = -jnp.sort(-logits, axis=-1)
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.clip(top_k - 1, 0, v - 1)[:, None], axis=-1)
+    logits = jnp.where((top_k > 0)[:, None] & (logits < kth), _NEG, logits)
+    # nucleus over the (possibly top-k-filtered) distribution, same
+    # exclusive-mass rule as the batch-wise version
+    sorted_desc = -jnp.sort(-logits, axis=-1)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p[:, None]
+    thresh = jnp.min(jnp.where(keep, sorted_desc, jnp.inf),
+                     axis=-1, keepdims=True)
+    return jnp.where((top_p < 1.0)[:, None] & (logits < thresh), _NEG,
+                     logits)
+
+
+def rowwise_dist(logits, temperature, top_k, top_p):
+    """The per-row SAMPLING distribution: softmax of the tempered,
+    filtered logits (rows with ``temperature == 0`` divide by 1 — their
+    value is never used by callers, which take the exact argmax path
+    instead).  Returns (B, V) probabilities."""
+    temperature = jnp.asarray(temperature, logits.dtype)
+    scaled = logits / jnp.where(temperature > 0.0, temperature,
+                                1.0)[:, None]
+    return jax.nn.softmax(filter_logits_rowwise(scaled, top_k, top_p),
+                          axis=-1)
+
+
+def sample_rowwise(rng_key, logits, temperature, top_k, top_p):
+    """One next-token draw per row under per-row sampling params: rows
+    with ``temperature[r] == 0`` take the EXACT argmax (the sampled
+    branch's value is discarded for them, never approximated — greedy
+    parity with :func:`generate_tokens` holds row by row), others sample
+    from the filtered, tempered distribution.  Returns int32 (B,)."""
+    temperature = jnp.asarray(temperature, logits.dtype)
+    greedy = temperature <= 0.0
+    scaled = logits / jnp.where(greedy, 1.0, temperature)[:, None]
+    filtered = filter_logits_rowwise(scaled, top_k, top_p)
+    sampled = jax.random.categorical(rng_key, filtered, axis=-1)
+    return jnp.where(greedy, jnp.argmax(logits, axis=-1),
+                     sampled).astype(jnp.int32)
+
+
 def decode_window(layer, params, state, tokens, cache, start, limit=None):
     """Cached multi-token decode window: feed ``tokens`` (B, K) through
     ``layer.apply_decode`` sequentially at positions ``start + i``

@@ -21,9 +21,14 @@ Layers:
 * ``server``  — ``ServeServer``: TCP front-end speaking the PS wire
   framing (hello/generate/stats/drain/stop) with v1/v2 negotiation.
 * ``client``  — ``ServeClient``: the worker-side connection.
+* ``router``  — ``ServeRouter`` (ISSUE 14): the engine-fleet front door
+  — prefix-affinity + least-loaded routing across N engines, fleet-
+  merged stats, fan-out ``promote`` with roll-forward on reconnect,
+  evict/requeue/rejoin failure handling.
 """
 
 from .config import ServeConfig  # noqa: F401
 from .engine import DecodeEngine, ServeRejected, ServeRequest  # noqa: F401
 from .server import ServeServer  # noqa: F401
 from .client import ServeClient  # noqa: F401
+from .router import RouterConfig, ServeRouter  # noqa: F401

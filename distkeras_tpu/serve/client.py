@@ -35,9 +35,18 @@ from ..ps.networking import (client_handshake, connect,
 class ServeClient:
     def __init__(self, host: str, port: int,
                  registry: Optional[Registry] = None,
-                 wire_version: Optional[int] = None):
+                 wire_version: Optional[int] = None,
+                 connect_retries: int = 20,
+                 connect_timeout: float = 30.0):
         self.host = host
         self.port = port
+        #: dial retries / per-attempt connect timeout before the
+        #: constructor raises — the router dials with small values so a
+        #: dead engine costs milliseconds per probe and a PARTITIONED
+        #: one (SYNs blackholed) seconds, not the default client
+        #: patience
+        self.connect_retries = max(1, int(connect_retries))
+        self.connect_timeout = float(connect_timeout)
         self.registry = registry if registry is not None \
             else default_registry()
         self._h_e2e = self.registry.histogram("serve.client.e2e_seconds",
@@ -50,7 +59,8 @@ class ServeClient:
             "serve.client.reconnect_failures")
         #: ``None`` negotiates; ``1`` pins legacy (also via DKTPU_WIRE=1)
         self._want_version = pinned_wire_version(wire_version)
-        self.sock = connect(host, port)
+        self.sock = connect(host, port, timeout=self.connect_timeout,
+                            retries=self.connect_retries)
         self.wire_version = client_handshake(self.sock,
                                              registry=self.registry,
                                              want=self._want_version)
@@ -93,15 +103,30 @@ class ServeClient:
                      version=self.wire_version)
             return recv_msg(self.sock, registry=self.registry)
 
-    def generate(self, prompt, max_new_tokens: Optional[int] = None) -> dict:
+    def generate(self, prompt, max_new_tokens: Optional[int] = None,
+                 temperature: Optional[float] = None,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None) -> dict:
         """One generation round-trip; blocks until the server finishes
         (or load-sheds) the request.  Returns the reply dict — check
         ``reply["ok"]``; on success ``reply["tokens"]`` holds the
-        generated int32 ids."""
+        generated int32 ids.
+
+        ``temperature`` / ``top_k`` / ``top_p`` ride the request (ISSUE
+        14) and override the engine's defaults for THIS generation only;
+        omitted params keep the service defaults.  Extra msgpack keys —
+        old servers ignore them (and sample at their configured
+        defaults), per the wire's extension contract."""
         msg: dict = {"action": "generate",
                      "prompt": np.asarray(prompt, np.int32).reshape(-1)}
         if max_new_tokens is not None:
             msg["max_new_tokens"] = int(max_new_tokens)
+        if temperature is not None:
+            msg["temperature"] = float(temperature)
+        if top_k is not None:
+            msg["top_k"] = int(top_k)
+        if top_p is not None:
+            msg["top_p"] = float(top_p)
         self._c_requests.inc()
         t0 = time.perf_counter()
         reply = self._rpc(msg)
@@ -110,10 +135,13 @@ class ServeClient:
             self._c_rejected.inc()
         return reply
 
-    def stats(self) -> dict:
+    def stats(self, retry: bool = True) -> dict:
         """Poll the service's live telemetry (registry snapshot + queue/
-        slot state) — no decode work, safe under load."""
-        return self._rpc({"action": "stats"}, retry=True)
+        slot state) — no decode work, safe under load.  ``retry=False``
+        skips the reconnect-and-retry (idempotent-read) path — the
+        router's health poller probes with it so a dead engine costs one
+        failed read, not a full backoff ladder."""
+        return self._rpc({"action": "stats"}, retry=retry)
 
     def promote(self, variables) -> dict:
         """Hot-swap the service's serving weights with ``variables`` —

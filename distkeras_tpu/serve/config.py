@@ -39,10 +39,15 @@ class ServeConfig:
       ``prompt_len + max_new <= seq_len``.
     * ``prefill_buckets`` — ascending prompt-pad lengths; None derives
       a geometric ladder ending at the model's ``seq_len``.
-    * ``temperature`` / ``top_k`` / ``top_p`` / ``eos_id`` — service-level
-      sampling controls, identical semantics to
+    * ``temperature`` / ``top_k`` / ``top_p`` / ``eos_id`` — sampling
+      controls, identical semantics to
       ``models.generation.generate_tokens`` (0.0 = greedy; ``eos_id``
-      finishes a row early).
+      finishes a row early).  ISSUE 14: the first three are the
+      per-request DEFAULTS — ``submit()`` / the ``generate`` RPC may
+      override them per request, and the params ride into the one
+      compiled step program as per-row traced values, so any mix of
+      greedy and sampled requests shares a batch at
+      ``jit.retraces == 0``.
     * ``seed`` — sampling PRNG seed (one stream for the whole service;
       with ``temperature == 0`` decoding is deterministic per request).
     * ``drain_timeout_s`` — graceful-drain bound: how long ``drain()``
@@ -66,9 +71,10 @@ class ServeConfig:
       ``DecodeEngine``) propose k tokens per active row per step, which
       the target verifies in ONE batched decode window — accepted-prefix
       rollback keeps the ragged KV cache exact and greedy output
-      provably equals ``generate_tokens``.  Greedy-only: requires
-      ``temperature == 0`` (distribution-preserving speculative
-      *sampling* is a follow-on, see ROADMAP).
+      provably equals ``generate_tokens``.  ISSUE 14: composes with
+      ``temperature > 0`` — sampled rows run the distribution-preserving
+      accept/reject (``serve/spec.py``), greedy rows keep the provably
+      parity-exact argmax chain.
     """
 
     slots: int = 4
@@ -116,13 +122,6 @@ class ServeConfig:
         if int(self.spec_k) < 0:
             raise ValueError(f"spec_k must be >= 0 (0 disables "
                              f"speculative decode), got {self.spec_k}")
-        if int(self.spec_k) > 0 and float(self.temperature) != 0.0:
-            raise ValueError(
-                f"speculative decode is greedy-only (spec_k="
-                f"{self.spec_k} with temperature={self.temperature}): "
-                f"verified acceptance proves argmax parity; "
-                f"distribution-preserving speculative sampling is not "
-                f"implemented")
 
     def resolved_buckets(self, seq_len: int) -> Tuple[int, ...]:
         """The ascending prefill-bucket lengths for a ``seq_len`` model:

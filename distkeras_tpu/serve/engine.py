@@ -88,7 +88,7 @@ import numpy as np
 from ..obs import Registry, TIME_BUCKETS
 from ..obs.logging import get_logger
 from ..obs.profile import RetraceSentinel
-from ..models.generation import _filter_logits, _model_cache, decode_window
+from ..models.generation import _model_cache, decode_window, sample_rowwise
 from .config import ServeConfig
 from .prefix import PrefixCache, PrefixEntry
 from .spec import build_spec_step, validate_draft
@@ -116,16 +116,27 @@ class ServeRequest:
     GENERATED token ids (eos included when sampled) as int32, raising
     ``ServeRejected`` if the engine aborted the request mid-flight.
     ``warm`` records the prefix-cache outcome at admission (None when
-    the cache is disabled)."""
+    the cache is disabled).
+
+    ``temperature`` / ``top_k`` / ``top_p`` are the request's RESOLVED
+    sampling params (ISSUE 14: they ride the request, not the engine
+    config — one fleet serves every temperature): ``top_k == 0`` and
+    ``top_p == 1.0`` are the disabled encodings the compiled step
+    program understands."""
 
     __slots__ = ("prompt", "length", "max_new", "tokens", "error",
                  "submit_t", "admit_t", "first_token_t", "done_t",
-                 "warm", "_done")
+                 "warm", "temperature", "top_k", "top_p", "_done")
 
-    def __init__(self, prompt: np.ndarray, max_new: int):
+    def __init__(self, prompt: np.ndarray, max_new: int,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0):
         self.prompt = prompt
         self.length = int(prompt.shape[0])
         self.max_new = int(max_new)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
         self.tokens: list = []
         self.error: Optional[str] = None
         self.submit_t = time.perf_counter()
@@ -317,6 +328,14 @@ class DecodeEngine:
         self._pos = jnp.zeros((b,), jnp.int32)
         self._logits = jnp.zeros((b, self._vocab), jnp.float32)
         self._rng = jax.random.PRNGKey(int(self.config.seed))
+        # per-row sampling params (ISSUE 14): decode-thread-private host
+        # arrays written at admit, shipped into the step program every
+        # dispatch — value changes never re-trace (shape/dtype fixed),
+        # so one compiled step serves every request's temperature/top-k/
+        # top-p mix.  0 / 0 / 1.0 are the "greedy, unfiltered" encodings
+        self._row_temp = np.zeros((b,), np.float32)
+        self._row_topk = np.zeros((b,), np.int32)
+        self._row_topp = np.ones((b,), np.float32)
         if self._spec_k > 0:
             self._dcache = _model_cache(self.draft_model, b)
             self._dlogits = jnp.zeros((b, self._vocab), jnp.float32)
@@ -497,19 +516,25 @@ class DecodeEngine:
                 self.model, self.draft_model, self._spec_k))
             return self._step_fn
         model, t = self.model, self._t
-        temperature = float(self.config.temperature)
-        top_k, top_p = self.config.top_k, self.config.top_p
 
-        def _step(variables, buf, cache, pos, logits, active, rng):
+        def _step(variables, buf, cache, pos, logits, active, temp,
+                  topk, topp, rng):
+            from jax import lax
             params, state = variables["params"], variables["state"]
-            if temperature > 0.0:
-                rng, sub = jax.random.split(rng)
-                filtered = _filter_logits(logits / temperature, top_k,
-                                          top_p)
-                nxt = jax.random.categorical(sub, filtered, axis=-1)
-            else:
-                nxt = jnp.argmax(logits, axis=-1)
-            nxt = nxt.astype(jnp.int32)
+            rng, sub = jax.random.split(rng)
+            # per-row sampling (ISSUE 14): temp/topk/topp are TRACED
+            # (B,) arrays — rows at temperature 0 take the exact argmax
+            # inside sample_rowwise, so greedy parity holds row by row.
+            # The sampled branch (two vocab-wide sorts, softmax,
+            # categorical) runs only when SOME row actually samples: an
+            # all-greedy batch — the default config — stays at the old
+            # argmax-only cost through lax.cond, whose traced predicate
+            # never re-traces
+            nxt = lax.cond(
+                jnp.any(jnp.asarray(temp) > 0.0),
+                lambda _: sample_rowwise(sub, logits, temp, topk, topp),
+                lambda _: jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                None)
             mask = active.astype(jnp.int32)
             w = jax.nn.one_hot(pos, t, dtype=jnp.int32) * mask[:, None]
             buf = buf * (1 - w) + nxt[:, None] * w
@@ -559,11 +584,13 @@ class DecodeEngine:
                               np.int32(row))
 
     def _step_args(self, active):
+        sampling = (self._row_temp, self._row_topk, self._row_topp,
+                    self._rng)
         if self._spec_k > 0:
             return (self._buf, self._cache, self._dcache, self._pos,
-                    self._logits, self._dlogits, active)
-        return (self._buf, self._cache, self._pos, self._logits, active,
-                self._rng)
+                    self._logits, self._dlogits, active) + sampling
+        return (self._buf, self._cache, self._pos, self._logits,
+                active) + sampling
 
     def warmup(self) -> "DecodeEngine":
         """Compile the full program ladder — every bucket's join, every
@@ -745,11 +772,19 @@ class DecodeEngine:
                 self._prefix.flush()
 
     # -- admission ----------------------------------------------------------
-    def submit(self, prompt, max_new_tokens: Optional[int] = None
-               ) -> ServeRequest:
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               temperature: Optional[float] = None,
+               top_k: Optional[int] = None,
+               top_p: Optional[float] = None) -> ServeRequest:
         """Queue one generation request.  Raises ``ValueError`` for
         malformed requests (client error) and ``ServeRejected`` when the
-        admission controller load-sheds (queue full / draining)."""
+        admission controller load-sheds (queue full / draining).
+
+        ``temperature`` / ``top_k`` / ``top_p`` override the engine
+        defaults PER REQUEST (ISSUE 14): the params ride into the one
+        compiled step program as per-row device values, so any mix of
+        greedy and sampled requests shares a batch without re-tracing —
+        ``jit.retraces`` stays 0."""
         self._c_requests.inc()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.shape[0] < 1:
@@ -760,13 +795,28 @@ class DecodeEngine:
             raise ValueError(
                 f"max_new_tokens must lie in [1, "
                 f"{self.config.max_new_tokens}], got {max_new}")
+        temperature = float(self.config.temperature) \
+            if temperature is None else float(temperature)
+        if not temperature >= 0.0:  # not-form: NaN must fail too
+            raise ValueError(
+                f"temperature must be >= 0, got {temperature}")
+        top_k = self.config.top_k if top_k is None else top_k
+        top_k = 0 if top_k is None else int(top_k)   # 0 = disabled
+        if top_k < 0:
+            raise ValueError(
+                f"top_k must be >= 0 (0/None disable it), got {top_k}")
+        top_p = self.config.top_p if top_p is None else top_p
+        top_p = 1.0 if top_p is None else float(top_p)  # 1.0 = disabled
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         # validates the prompt fits a bucket too
         self.config.bucket_for(int(prompt.shape[0]), self._t)
         if int(prompt.shape[0]) + max_new > self._t:
             raise ValueError(
                 f"prompt length {prompt.shape[0]} + {max_new} new tokens "
                 f"exceeds the model's seq_len {self._t}")
-        req = ServeRequest(prompt, max_new)
+        req = ServeRequest(prompt, max_new, temperature=temperature,
+                           top_k=top_k, top_p=top_p)
         with self._lock:
             if self._draining:
                 self._c_rejected.inc()
@@ -859,6 +909,11 @@ class DecodeEngine:
             else:
                 self._join_cold(req, row)
             self._h_join.observe(time.perf_counter() - t0)
+            # the row adopts the request's sampling params (decode-
+            # thread-private arrays, shipped into every step dispatch)
+            self._row_temp[row] = req.temperature
+            self._row_topk[row] = req.top_k
+            self._row_topp[row] = req.top_p
             self._slots[row].request = req
             self._c_admitted.inc()
             self._c_joins.inc()
@@ -886,7 +941,7 @@ class DecodeEngine:
         if self._spec_k > 0:
             self._sentinel("spec_step").observe(args)
             (self._buf, self._cache, self._dcache, self._pos,
-             self._logits, self._dlogits, tokens, counts) = \
+             self._logits, self._dlogits, self._rng, tokens, counts) = \
                 self._build_step()(self._variables,
                                    self._draft_variables, *args)
         else:

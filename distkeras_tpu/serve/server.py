@@ -8,11 +8,14 @@ module and ``ps.servers`` live there once).  Every request is one framed
 msgpack map with an ``action`` key:
 
 * ``hello``    — wire-format negotiation (``FrameServer``).
-* ``generate`` — ``{"prompt": int32 array, "max_new_tokens": int?}`` ->
+* ``generate`` — ``{"prompt": int32 array, "max_new_tokens": int?,
+  "temperature": float?, "top_k": int?, "top_p": float?}`` ->
   ``{"ok": True, "tokens": int32 array, ...timings}`` or a load-shed
   ``{"ok": False, "rejected": True, "reason": ...}`` (admission control)
   or ``{"ok": False, "error": ...}`` for malformed requests.  Prompt and
-  tokens ride as tensors — zero-copy on v2 connections.
+  tokens ride as tensors — zero-copy on v2 connections.  The sampling
+  keys are per-request overrides of the engine defaults (ISSUE 14);
+  old servers ignore them, per the wire's extension contract.
 * ``stats``    — live registry snapshot + queue/slot state, no decode
   work: the ``obsview --serve`` / ``--continual`` poll path.
 * ``promote``  — ``{"variables": pytree}`` -> checkpoint hot-swap via
@@ -105,7 +108,10 @@ class ServeServer(FrameServer):
             return {"ok": False, "error": "generate needs a prompt"}
         try:
             req = self.engine.submit(np.asarray(prompt),
-                                     msg.get("max_new_tokens"))
+                                     msg.get("max_new_tokens"),
+                                     temperature=msg.get("temperature"),
+                                     top_k=msg.get("top_k"),
+                                     top_p=msg.get("top_p"))
         except ServeRejected as e:
             return {"ok": False, "rejected": True, "reason": e.reason}
         except (ValueError, TypeError) as e:

@@ -1,18 +1,36 @@
-"""Speculative decoding — decode accelerator #2 (ISSUE 11).
+"""Speculative decoding — decode accelerator #2 (ISSUE 11; ISSUE 14
+makes it DISTRIBUTION-PRESERVING, so ``spec_k`` composes with
+``temperature > 0``).
 
 One-token-per-step decode leaves the target model memory-bound: every
 step reads the full parameter set to produce ONE token per row.  A small
 **draft** model (the ``gpt_lm`` family already scales down) proposes
 ``k`` tokens per active row; the target then verifies all ``k`` in ONE
 batched ``decode_window`` — the accepted prefix ships ``m + 1`` tokens
-(the ``m`` matching proposals plus the target's own next token) for a
-single target-weight read plus one fix-up decode.
+(the ``m`` accepted proposals plus one final token) for a single
+target-weight read plus one fix-up decode.
 
-Greedy-only, with provable parity: a proposal ``x_i`` is accepted iff it
-equals the target's own argmax given the previously accepted context, so
-every emitted token is exactly the token ``generate_tokens`` would have
-produced — at ANY draft quality.  A bad draft costs speed (low accept
-rate), never correctness.
+Acceptance is per-row, under the row's OWN sampling params (they ride
+the request — ISSUE 14):
+
+* **Greedy rows** (``temperature == 0``): a proposal ``x_i`` is accepted
+  iff it equals the target's own argmax given the previously accepted
+  context, so every emitted token is exactly the token
+  ``generate_tokens`` would have produced — at ANY draft quality.  A bad
+  draft costs speed (low accept rate), never correctness.  This path is
+  provably parity-exact and unchanged by the sampling extension.
+* **Sampled rows** (``temperature > 0``): the classic
+  speculative-*sampling* accept/reject — the draft proposes
+  ``x_i ~ q_i`` (its own tempered, filtered distribution), the target
+  accepts with probability ``min(1, p_i(x_i) / q_i(x_i))`` where ``p_i``
+  is ITS tempered, filtered distribution given the accepted context; on
+  the first rejection the final token is drawn from the normalized
+  residual ``max(p_i - q_i, 0)``, and after ``k`` acceptances a bonus
+  token is drawn from the target's next-position distribution.  The
+  emitted sequence is distributed EXACTLY as sampling from the target
+  alone — the residual construction makes the marginal at every
+  position ``p_i`` regardless of draft quality (the standard
+  speculative-sampling identity).
 
 **Accepted-prefix rollback keeps the ragged KV cache exact** without
 copying anything back: the verify window writes K/V for all ``k``
@@ -23,8 +41,9 @@ padding).  Rolling back IS just not advancing ``pos``.
 
 The whole step — draft propose scan, target verify window, acceptance
 arithmetic, buffer scatter, target + draft fix-up decode — is one
-compiled program behind one retrace sentinel, so steady-state serving
-stays ``jit.retraces == 0``.
+compiled program behind one retrace sentinel; the sampling params are
+TRACED per-row arrays, so steady-state serving stays
+``jit.retraces == 0`` across any mix of greedy and sampled requests.
 
 Metrics (service registry, recorded by the engine): counters
 ``serve.spec.proposed`` / ``serve.spec.accepted``, gauge
@@ -34,7 +53,12 @@ LOW-ACCEPT alarm when it collapses).
 
 from __future__ import annotations
 
-from ..models.generation import _model_cache, decode_window
+from ..models.generation import (_model_cache, decode_window,
+                                 rowwise_dist)
+
+#: floor added before ``log`` on probability tensors — keeps zero-mass
+#: entries at -inf-ish log-probability without producing NaN
+_TINY = 1e-30
 
 
 def validate_draft(model, draft_model, draft_variables, batch: int,
@@ -71,10 +95,13 @@ def build_spec_step(model, draft_model, spec_k: int):
     """The compiled speculative step for ``DecodeEngine``.
 
     Returns ``fn(variables, dvariables, buf, cache, dcache, pos, logits,
-    dlogits, active) -> (buf, cache, dcache, pos, logits, dlogits,
-    emitted, counts)`` where ``emitted`` is (B, k+1) int32 — row r's
-    tokens for positions ``pos_r .. pos_r + counts_r - 1`` — and
-    ``counts`` is (B,) int32 in [1, k+1] (valid only where ``active``).
+    dlogits, active, temp, topk, topp, rng) -> (buf, cache, dcache, pos,
+    logits, dlogits, rng, emitted, counts)`` where ``emitted`` is
+    (B, k+1) int32 — row r's tokens for positions
+    ``pos_r .. pos_r + counts_r - 1`` — and ``counts`` is (B,) int32 in
+    [1, k+1] (valid only where ``active``).  ``temp``/``topk``/``topp``
+    are the per-row sampling params ((B,) arrays; ``temp == 0`` selects
+    the greedy argmax-acceptance path for that row).
 
     Alignment invariant (matches the engine's carried state): ``logits``
     / ``dlogits`` are each model's distribution for the token AT ``pos``.
@@ -87,68 +114,154 @@ def build_spec_step(model, draft_model, spec_k: int):
     t = int(model.input_shape[0])
 
     def _spec_step(variables, dvariables, buf, cache, dcache, pos,
-                   logits, dlogits, active):
+                   logits, dlogits, active, temp, topk, topp, rng):
         params, state = variables["params"], variables["state"]
         dparams, dstate = dvariables["params"], dvariables["state"]
         b = buf.shape[0]
+        temp = jnp.asarray(temp, logits.dtype)
+        greedy = temp <= 0.0                                # (B,)
+        #: traced batch-level predicate: every sampled-path computation
+        #: below (draft distributions, acceptance ratios, residual
+        #: draws — sorts and softmaxes the greedy chain never reads)
+        #: sits behind a lax.cond on it, so an all-greedy batch pays
+        #: the PR 11 argmax-only cost; the cond never re-traces
+        any_sampled = jnp.any(~greedy)
 
-        # 1) draft proposes k tokens: x_i = argmax of its carried
-        # distribution, fed back at position pos + i (clamped like every
-        # possibly-overrunning write; see decode_window's contract)
+        # 1) draft proposes k tokens: greedy rows take its carried
+        # argmax, sampled rows draw x_i ~ q_i (the draft's tempered,
+        # filtered distribution — RECORDED, the acceptance test and the
+        # residual both need q), each fed back at position pos + i
+        # (clamped like every possibly-overrunning write)
         def propose(carry, i):
-            dl, dc = carry
-            x = jnp.argmax(dl, axis=-1).astype(jnp.int32)
+            dl, dc, r = carry
+            r, sub = jax.random.split(r)
+
+            def q_sample(_):
+                q = rowwise_dist(dl, temp, topk, topp)      # (B, V)
+                xs = jax.random.categorical(sub, jnp.log(q + _TINY),
+                                            axis=-1)
+                return q, xs.astype(jnp.int32)
+
+            def q_skip(_):
+                # all-greedy: q is never read downstream (acceptance
+                # and residual live behind the same predicate)
+                return (jnp.zeros_like(dl),
+                        jnp.zeros((b,), jnp.int32))
+
+            q, xs = lax.cond(any_sampled, q_sample, q_skip, None)
+            x = jnp.where(greedy, jnp.argmax(dl, axis=-1),
+                          xs).astype(jnp.int32)
             p = jnp.minimum(pos + i, t - 1)
             dl2, dc = draft_model.layer.apply_decode(dparams, dstate, x,
                                                      dc, p)
-            return (dl2, dc), x
+            return (dl2, dc, r), (x, q)
 
-        (_, dcache), xs = lax.scan(propose, (dlogits, dcache),
-                                   jnp.arange(k))
+        (_, dcache, rng), (xs, qs) = lax.scan(
+            propose, (dlogits, dcache, rng), jnp.arange(k))
         proposals = jnp.moveaxis(xs, 0, 1)                  # (B, k)
+        qs = jnp.moveaxis(qs, 0, 1)                         # (B, k, V)
 
         # 2) target verifies all k proposals in one batched window
         win, cache = decode_window(model.layer, params, state, proposals,
                                    cache, pos, limit=t)     # (B, k, V)
 
-        # 3) acceptance: the target's own argmax chain.  targets[:, i]
-        # is the target token AT pos+i given proposals[:, :i] — valid
-        # exactly when those proposals were all accepted, which the
-        # cumulative product encodes.
+        # 3a) greedy acceptance: the target's own argmax chain
         y0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
         yw = jnp.argmax(win, axis=-1).astype(jnp.int32)     # (B, k)
         targets = jnp.concatenate([y0, yw], axis=1)         # (B, k+1)
-        accepted = jnp.cumprod(
-            (proposals == targets[:, :k]).astype(jnp.int32), axis=1)
+        acc_g = proposals == targets[:, :k]
+
+        # 3b) stochastic acceptance: u <= p(x)/q(x) (q(x) > 0 — x was
+        # drawn from q), the distribution-preserving test.  ``ps`` is
+        # the target's tempered/filtered distribution for the token AT
+        # pos+i given proposals[:, :i] — valid exactly when those
+        # proposals were all accepted, which the cumulative product
+        # below encodes
+        rng, sub = jax.random.split(rng)
+
+        def acc_sampled(_):
+            tgt = jnp.concatenate([logits[:, None, :],
+                                   win[:, :k - 1, :]],
+                                  axis=1)                   # (B, k, V)
+            ps = rowwise_dist(tgt.reshape(b * k, -1),
+                              jnp.repeat(temp, k),
+                              jnp.repeat(topk, k),
+                              jnp.repeat(topp, k)).reshape(b, k, -1)
+            p_x = jnp.take_along_axis(ps, proposals[..., None],
+                                      axis=-1)[..., 0]      # (B, k)
+            q_x = jnp.take_along_axis(qs, proposals[..., None],
+                                      axis=-1)[..., 0]
+            u = jax.random.uniform(sub, (b, k), dtype=p_x.dtype)
+            return jnp.where(greedy[:, None], acc_g,
+                             u * q_x <= p_x), ps
+
+        def acc_greedy(_):
+            return acc_g, jnp.zeros((b, k, win.shape[-1]), win.dtype)
+
+        acc, ps = lax.cond(any_sampled, acc_sampled, acc_greedy, None)
+        accepted = jnp.cumprod(acc.astype(jnp.int32), axis=1)
         m = accepted.sum(axis=1)                            # (B,) in [0,k]
         counts = m + 1
 
-        # 4) emit targets[:, :m+1] into the buffer at pos .. pos+m (a
-        # write past seq_len one-hots to the zero vector — dropped, the
-        # row is retiring anyway)
+        # 4) the final (m-th) emitted token per row: greedy -> the
+        # target chain's own token; sampled + rejection at m < k -> a
+        # draw from the normalized residual max(p_m - q_m, 0) (rejection
+        # implies positive residual mass; the epsilon fallback to p_m
+        # covers numerically-tied p == q); sampled + all k accepted ->
+        # a bonus draw from the target's next-position distribution
+        rng, sub = jax.random.split(rng)
+        f_g = jnp.take_along_axis(targets, m[:, None], axis=1)[:, 0]
+
+        def final_sampled(ps):
+            bonus = rowwise_dist(win[:, k - 1, :], temp, topk, topp)
+            m_idx = jnp.minimum(m, k - 1)[:, None, None]
+            resid = jnp.take_along_axis(jnp.maximum(ps - qs, 0.0),
+                                        m_idx, axis=1)[:, 0, :]  # (B, V)
+            mass = resid.sum(axis=-1, keepdims=True)
+            p_m = jnp.take_along_axis(ps, m_idx, axis=1)[:, 0, :]
+            resid = jnp.where(mass > 1e-9,
+                              resid / jnp.maximum(mass, _TINY), p_m)
+            final_dist = jnp.where((m == k)[:, None], bonus, resid)
+            f_s = jax.random.categorical(sub,
+                                         jnp.log(final_dist + _TINY),
+                                         axis=-1)
+            return jnp.where(greedy, f_g, f_s).astype(jnp.int32)
+
+        final = lax.cond(any_sampled, final_sampled,
+                         lambda _: f_g.astype(jnp.int32), ps)
+
+        # row r emits proposals[:m_r] then `final` at index m_r (greedy
+        # rows: identical to the old targets[:, :m+1] emission — an
+        # accepted proposal IS the target's token there)
         idx = jnp.arange(k + 1)[None, :]
+        prop_pad = jnp.concatenate([proposals, proposals[:, -1:]],
+                                   axis=1)                  # (B, k+1)
+        emitted = jnp.where(idx == m[:, None], final[:, None], prop_pad)
+
+        # 5) emit into the buffer at pos .. pos+m (a write past seq_len
+        # one-hots to the zero vector — dropped, the row is retiring)
         keep = (idx <= m[:, None]) & active[:, None]        # (B, k+1)
         w = jax.nn.one_hot(pos[:, None] + idx, t,
                            dtype=jnp.int32) * keep[..., None].astype(
                                jnp.int32)                   # (B, k+1, T)
-        buf = buf * (1 - w.sum(1)) + (targets[..., None] * w).sum(1)
+        buf = buf * (1 - w.sum(1)) + (emitted[..., None] * w).sum(1)
 
-        # 5) fix-up decode of the LAST emitted token (the correction /
+        # 6) fix-up decode of the LAST emitted token (the correction /
         # bonus the draft never saw): gives the carried logits for
         # pos+m+1 and overwrites the one wrong K/V slot a rejected
         # proposal left at pos+m — both models stay exactly in sync
         # with the emitted context
-        last = jnp.take_along_axis(targets, m[:, None], axis=1)[:, 0]
         pfix = jnp.minimum(pos + m, t - 1)
-        l2, cache = model.layer.apply_decode(params, state, last, cache,
+        l2, cache = model.layer.apply_decode(params, state, final, cache,
                                              pfix)
         logits = jnp.where(active[:, None], l2.astype(logits.dtype),
                            logits)
         dl2, dcache = draft_model.layer.apply_decode(dparams, dstate,
-                                                     last, dcache, pfix)
+                                                     final, dcache, pfix)
         dlogits = jnp.where(active[:, None], dl2.astype(dlogits.dtype),
                             dlogits)
         pos = pos + counts * active.astype(jnp.int32)
-        return buf, cache, dcache, pos, logits, dlogits, targets, counts
+        return (buf, cache, dcache, pos, logits, dlogits, rng, emitted,
+                counts)
 
     return _spec_step

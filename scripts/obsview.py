@@ -14,12 +14,17 @@ Three modes:
   ``SocketParameterServer`` via its ``stats`` RPC and print the registry
   snapshot + straggler state (``--prometheus`` renders Prometheus text
   instead — pipe it anywhere that scrapes the standard format).
-* ``python scripts/obsview.py --serve HOST:PORT`` — poll a LIVE decode
+* ``python scripts/obsview.py --serve TARGET`` — poll a LIVE decode
   service (``distkeras_tpu/serve``) via its ``stats`` RPC: the SLO
   latency table (queue-wait / time-to-first-token / per-token /
   end-to-end p50/p99), admission-control counters (requests, rejected by
   reason), queue/slot occupancy, and the retrace sentinel — the serving
-  health check (ISSUE 7).
+  health check (ISSUE 7).  A ``ServeRouter`` target — or a
+  comma-separated engine fleet, like ``--ps`` shard fleets — renders
+  the MERGED fleet SLO view plus a per-engine balance table
+  (requests/occupancy/prefix-hit share) and a MISROUTED alarm when the
+  fleet's affinity hit rate trails the single-engine baseline
+  (ISSUE 14).
 * ``python scripts/obsview.py --diff BASE CAND`` — drift-gate two
   persisted registry-snapshot files (``obs.drift``): counter ratio deltas,
   bucket-wise PSI + p50/p99 shift per histogram, thresholds from the
@@ -623,6 +628,13 @@ _SLO_HISTS = (("serve.queue_wait_seconds", "queue wait"),
 #: speculative speedup is gone (correctness never depends on it)
 _LOW_ACCEPT = 0.25
 
+#: fleet prefix hit rate below this (with lookups flowing, >1 engine)
+#: renders the MISROUTED alarm (ISSUE 14): on a shared-prefix workload a
+#: correctly affinity-routed fleet holds the single-engine warm baseline
+#: (the committed bench's single-engine point), so a rate trailing it
+#: means requests are landing on engines that don't hold their prefix
+_MISROUTE_RATE = 0.5
+
 
 def _accel_lines(stats: dict) -> list:
     """The ISSUE 11 accelerator panel: prefix-cache hit rate + LRU
@@ -653,9 +665,111 @@ def _accel_lines(stats: dict) -> list:
     return lines
 
 
+def _router_lines(stats: dict) -> list:
+    """The ISSUE 14 front-door panel (rendered when the polled stats
+    carry ``serve.router.*`` — i.e. the target is a ``ServeRouter`` or a
+    fleet list that includes one): routing split, failure handling, and
+    the fleet promote trail."""
+
+    def _v(name):
+        return stats.get(name, {}).get("value", 0)
+
+    lines = ["", "== Router =="]
+    lines.append(
+        f"routed: {_v('serve.router.requests'):,.0f}  (affinity "
+        f"{_v('serve.router.affinity_hits'):,.0f}, least-loaded "
+        f"{_v('serve.router.affinity_misses'):,.0f}, decays "
+        f"{_v('serve.router.affinity_decays'):,.0f})   engines alive: "
+        f"{_v('serve.router.engines_alive'):,.0f}")
+    lines.append(
+        f"failures: evictions {_v('serve.router.evictions'):,.0f}  "
+        f"requeues {_v('serve.router.requeues'):,.0f}  rejoins "
+        f"{_v('serve.router.rejoins'):,.0f}   promotes "
+        f"{_v('serve.router.promotes'):,.0f}  (failed "
+        f"{_v('serve.router.promote_failures'):,.0f}, rolled forward "
+        f"{_v('serve.router.promote_rollforwards'):,.0f})")
+    return lines
+
+
+def _engine_balance_lines(engines: list, stats: dict) -> list:
+    """Per-engine balance table (ISSUE 14): request/occupancy/prefix-hit
+    share per engine, plus the MISROUTED alarm when the fleet's prefix
+    hit rate trails the single-engine baseline."""
+    lines = ["", "== Engine balance ==",
+             f"{'engine':<22} {'alive':<6} {'reqs':>7} {'share':>6}  "
+             f"{'active':>6} {'queue':>5}  {'hit rate':>8}"]
+    total = sum(_num(e.get("requests"), 0) for e in engines) or 1.0
+    for e in engines:
+        hits = _num(e.get("prefix_hits"), 0)
+        misses = _num(e.get("prefix_misses"), 0)
+        looked = hits + misses
+        reqs = _num(e.get("requests"), 0)
+        lines.append(
+            f"{str(e.get('addr', '?')):<22} "
+            f"{('yes' if e.get('alive', True) else 'NO'):<6} "
+            f"{reqs:>7,.0f} {100 * reqs / total:>5.1f}%  "
+            f"{_num(e.get('active_slots'), 0):>6,.0f} "
+            f"{_num(e.get('queue_depth'), 0):>5,.0f}  "
+            + (f"{hits / looked:>8.0%}" if looked else f"{'-':>8}"))
+    hits = _num(stats.get("serve.prefix.hits", {}).get("value"), 0)
+    misses = _num(stats.get("serve.prefix.misses", {}).get("value"), 0)
+    looked = hits + misses
+    if len(engines) > 1 and looked and hits / looked < _MISROUTE_RATE:
+        lines.append(
+            f"<< MISROUTED (fleet prefix hit rate {hits / looked:.0%} "
+            f"trails the single-engine warm baseline; affinity routing "
+            f"is not landing requests on the engines that hold their "
+            f"prefixes)")
+    return lines
+
+
+def merge_serve_replies(replies: list) -> dict:
+    """N per-engine ``stats`` replies -> ONE router-reply-shaped view
+    (ISSUE 14): merged registry via ``Registry.merge_snapshots`` (the
+    shard-fleet primitive), summed occupancy, and a synthesized
+    per-engine balance list — so ``--serve a:1,b:2,c:3`` renders like a
+    ``ServeRouter`` poll."""
+    from distkeras_tpu.obs import Registry
+    merged = Registry.merge_snapshots(*[r.get("stats", {})
+                                        for r in replies])
+    engines = []
+    for i, r in enumerate(replies):
+        s = r.get("stats", {})
+
+        def _v(name):
+            return s.get(name, {}).get("value", 0)
+
+        engines.append({"addr": r.get("addr", f"engine {i}"),
+                        "alive": True,
+                        "requests": _v("serve.requests"),
+                        "completed": _v("serve.completed"),
+                        "queue_depth": r.get("queue_depth"),
+                        "active_slots": r.get("active_slots"),
+                        "slots": r.get("slots"),
+                        "prefix_hits": _v("serve.prefix.hits"),
+                        "prefix_misses": _v("serve.prefix.misses")})
+    return {"stats": merged,
+            "server": f"{replies[0].get('server', '?')} "
+                      f"×{len(replies)} engines",
+            "model": replies[0].get("model"),
+            "seq_len": replies[0].get("seq_len"),
+            "prefill_buckets": replies[0].get("prefill_buckets"),
+            "slots": sum(int(r.get("slots", 0) or 0) for r in replies),
+            "queue_depth": sum(int(r.get("queue_depth", 0) or 0)
+                               for r in replies),
+            "active_slots": sum(int(r.get("active_slots", 0) or 0)
+                                for r in replies),
+            "draining": any(r.get("draining") for r in replies),
+            "engines": engines}
+
+
 def summarize_serve(reply: dict) -> str:
     """Live-poll summary from a decode service's ``stats`` RPC reply:
-    SLO latency table, admission counters, occupancy, retrace health."""
+    SLO latency table, admission counters, occupancy, retrace health.
+    A fleet-shaped reply (a ``ServeRouter`` poll, or
+    :func:`merge_serve_replies` over an engine list) additionally
+    renders the router panel and the per-engine balance table with the
+    MISROUTED alarm (ISSUE 14)."""
     stats = reply.get("stats", {})
 
     def _cval(name):
@@ -698,6 +812,11 @@ def summarize_serve(reply: dict) -> str:
                     if retraces else ""))
     lines += ["", "== Accelerators =="]
     lines.extend(_accel_lines(stats))
+    if "serve.router.requests" in stats:
+        lines.extend(_router_lines(stats))
+    engines = reply.get("engines")
+    if engines:
+        lines.extend(_engine_balance_lines(engines, stats))
     lines += ["", "== Instruments =="]
     lines.extend(_instrument_lines(stats))
     return "\n".join(lines)
@@ -706,7 +825,25 @@ def summarize_serve(reply: dict) -> str:
 def poll_serve(host: str, port: int) -> dict:
     from distkeras_tpu.serve import ServeClient
     with ServeClient(host, int(port)) as client:
-        return client.stats()
+        reply = client.stats()
+    if isinstance(reply, dict):
+        reply.setdefault("addr", f"{host}:{port}")
+    return reply
+
+
+def parse_serve_targets(arg: str) -> list:
+    """``--serve`` target(s) -> [(host, port), ...]: a single HOST:PORT
+    (an engine or a ``ServeRouter``) or a comma-separated engine fleet
+    (ISSUE 14, like ``--ps`` shard fleets)."""
+    targets = []
+    for part in str(arg).split(","):
+        host, _, port = part.strip().rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"--serve expects HOST:PORT (single or "
+                             f"comma-separated fleet), got "
+                             f"{part.strip()!r}")
+        targets.append((host, int(port)))
+    return targets
 
 
 #: the continual-training health surface, rendered in this order (ISSUE 8)
@@ -865,10 +1002,14 @@ def main(argv=None) -> int:
                          "plan file polls every shard of a sharded PS "
                          "and renders ONE merged view with a per-shard "
                          "balance table (ISSUE 10)")
-    ap.add_argument("--serve", metavar="HOST:PORT",
+    ap.add_argument("--serve", metavar="TARGET",
                     help="poll a live decode service's stats RPC (SLO "
                          "latency table, admission counters, retrace "
-                         "health)")
+                         "health); a ServeRouter target or a comma-"
+                         "separated engine fleet additionally renders "
+                         "the merged fleet view with a per-engine "
+                         "balance table and the MISROUTED alarm "
+                         "(ISSUE 14)")
     ap.add_argument("--continual", metavar="TARGET",
                     help="continual-loop view (ISSUE 8): HOST:PORT polls "
                          "a live decode service whose registry the "
@@ -924,10 +1065,13 @@ def main(argv=None) -> int:
         return 0
 
     if args.serve:
-        host, _, port = args.serve.rpartition(":")
-        if not host or not port.isdigit():
-            ap.error(f"--serve expects HOST:PORT, got {args.serve!r}")
-        reply = poll_serve(host, int(port))
+        try:
+            targets = parse_serve_targets(args.serve)
+        except ValueError as e:
+            ap.error(str(e))
+        replies = [poll_serve(h, p) for h, p in targets]
+        reply = replies[0] if len(replies) == 1 \
+            else merge_serve_replies(replies)
         emit(to_prometheus_text(reply.get("stats", {})) if args.prometheus
              else summarize_serve(reply))
         return 0

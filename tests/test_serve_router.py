@@ -1,0 +1,632 @@
+"""Engine-fleet front door (ISSUE 14): ``ServeRouter`` routing
+correctness — prefix-affinity measurably above hash-random on a
+shared-prefix workload, least-loaded spill under the per-engine
+in-flight bound, affinity decay validated against engine prefix
+counters — plus fleet promote atomicity with one engine down (and the
+roll-forward on rejoin), engine-eviction re-queue accounting
+(``serve.router.requests == completed + rejected`` stays exact),
+v1-pinned engine interop, the ``obsview --serve`` fleet view with the
+MISROUTED alarm, and a drift-gated ``jit.retraces == 0`` fleet
+acceptance run under mixed per-request sampling traffic."""
+
+import copy
+import importlib.util
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.models import zoo
+from distkeras_tpu.models.generation import generate_tokens
+from distkeras_tpu.obs import Registry, drift
+from distkeras_tpu.serve import (DecodeEngine, RouterConfig, ServeClient,
+                                 ServeConfig, ServeRouter, ServeServer)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+VOCAB, SEQ = 32, 32
+BLOCK = 8
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = zoo.gpt_lm(vocab_size=VOCAB, dim=16, num_heads=2,
+                       num_blocks=1, seq_len=SEQ)
+    return model, model.init(0)
+
+
+def _engine(lm, registry=None, variables=None, **kw):
+    model, v = lm
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_queue", 16)
+    kw.setdefault("max_new_tokens", 12)
+    kw.setdefault("prefill_buckets", (BLOCK * 2, SEQ))
+    kw.setdefault("prefix_cache", True)
+    kw.setdefault("prefix_cache_mb", 8.0)
+    kw.setdefault("prefix_block", BLOCK)
+    return DecodeEngine(model, v if variables is None else variables,
+                        ServeConfig(**kw),
+                        registry=registry if registry is not None
+                        else Registry()).warmup()
+
+
+def _fleet(lm, n, **kw):
+    return [ServeServer(_engine(lm, **kw)).start() for _ in range(n)]
+
+
+def _router(servers, **cfg_kw):
+    cfg_kw.setdefault("affinity_block", BLOCK)
+    # default the poller OFF the test's critical path: most tests drive
+    # eviction/affinity deterministically and must not race a tick
+    cfg_kw.setdefault("stats_interval_s", 30.0)
+    return ServeRouter([("127.0.0.1", s.port) for s in servers],
+                       config=RouterConfig(**cfg_kw)).start()
+
+
+def _stop_all(router, servers):
+    router.stop()
+    for s in servers:
+        s.stop()
+
+
+def _ref(lm, prompt, steps, variables=None):
+    model, v = lm
+    out = generate_tokens(model, v if variables is None else variables,
+                          np.asarray(prompt, np.int32)[None, :],
+                          int(steps))
+    return np.asarray(out)[0, len(prompt):]
+
+
+def _groups(rng, n, shared_len=BLOCK * 2):
+    return [rng.integers(0, VOCAB, size=(shared_len,)).astype(np.int32)
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# config + routing units
+# ---------------------------------------------------------------------------
+
+def test_router_config_validation():
+    with pytest.raises(ValueError):
+        RouterConfig(affinity_block=0)
+    with pytest.raises(ValueError):
+        RouterConfig(max_inflight=0)
+    with pytest.raises(ValueError):
+        RouterConfig(stats_interval_s=0.0)
+    with pytest.raises(ValueError):
+        RouterConfig(decay_ratio=1.5)
+    with pytest.raises(ValueError):
+        RouterConfig(request_timeout_s=0.0)
+    with pytest.raises(ValueError):
+        ServeRouter([])  # a front door needs a fleet
+    with pytest.raises(ValueError):
+        ServeRouter(["not-an-address"])
+    # both target spellings parse
+    r = ServeRouter([("127.0.0.1", 1), "127.0.0.1:2"])
+    assert [b.addr for b in r.backends] == ["127.0.0.1:1", "127.0.0.1:2"]
+
+
+def test_route_affinity_then_least_loaded_with_inflight_bound():
+    """Routing unit semantics, no sockets: a routed prefix sticks to its
+    engine; an affine engine AT the in-flight bound spills to the
+    least-loaded survivor (one hot prefix cannot wedge an engine); a
+    fleet-wide full house is a recorded no-backend outcome."""
+    router = ServeRouter([("127.0.0.1", 1), ("127.0.0.1", 2)],
+                         config=RouterConfig(affinity_block=BLOCK,
+                                             max_inflight=2))
+    rng = np.random.default_rng(0)
+    prompt = np.concatenate([_groups(rng, 1)[0],
+                             rng.integers(0, VOCAB, 3).astype(np.int32)])
+    be0, affine = router._route(prompt)
+    assert affine is False
+    be1, affine = router._route(prompt)
+    assert be1 is be0 and affine is True  # prefix affinity sticks
+    # drain the taken in-flight slots back out
+    with router._lock:
+        be0.inflight = 0
+    # affine engine at the bound spills to the other engine, non-affine
+    with router._lock:
+        be0.inflight = 2
+    spill, affine = router._route(prompt)
+    assert spill is not be0 and affine is False
+    # the transient spill must NOT steal the live owner's affinity:
+    # once be0 is admissible again the prefix routes straight back to
+    # its warm KV
+    with router._lock:
+        be0.inflight = 0
+        spill.inflight = 0
+    back, affine = router._route(prompt)
+    assert back is be0 and affine is True
+    # a full house everywhere is a recorded reject
+    with router._lock:
+        for be in router.backends:
+            be.inflight = 2
+    none, affine = router._route(prompt)
+    assert none is None
+    snap = router.registry.snapshot()
+    assert snap["serve.router.affinity_hits"]["value"] == 2
+    assert snap["serve.router.affinity_misses"]["value"] == 2
+
+
+def test_affinity_decay_validated_against_engine_hits():
+    """The affinity table is validated against the engine's OWN
+    ``serve.prefix.hits``: a poll window in which the router sent an
+    engine affinity traffic but its admit-time lookups missed (promote
+    flush, LRU eviction) drops that engine's affinity entries —
+    misrouted affinity decays instead of pinning traffic cold."""
+    router = ServeRouter([("127.0.0.1", 1), ("127.0.0.1", 2)],
+                         config=RouterConfig(affinity_block=BLOCK,
+                                             decay_min_routed=4,
+                                             decay_ratio=0.5))
+    rng = np.random.default_rng(1)
+    prompt = np.concatenate([_groups(rng, 1)[0],
+                             rng.integers(0, VOCAB, 3).astype(np.int32)])
+    be, _ = router._route(prompt)
+    for _ in range(5):  # affinity-routed traffic into the window
+        got, affine = router._route(prompt)
+        assert got is be and affine
+
+    def reply(hits, misses):
+        return {"queue_depth": 0, "active_slots": 0,
+                "stats": {"serve.prefix.hits":
+                          {"type": "counter", "value": hits},
+                          "serve.prefix.misses":
+                          {"type": "counter", "value": misses}}}
+
+    # window 1: the engine admitted and HIT them all — no decay
+    router._adopt_stats(be, reply(5, 1))
+    assert len(router._affinity) > 0
+    assert router.registry.snapshot()[
+        "serve.router.affinity_decays"]["value"] == 0
+    # more affinity-routed traffic, but this window the engine MISSED
+    for _ in range(5):
+        router._route(prompt)
+    router._adopt_stats(be, reply(5, 7))  # +0 hits, +6 lookups
+    assert len(router._affinity) == 0
+    snap = router.registry.snapshot()
+    assert snap["serve.router.affinity_decays"]["value"] == 1
+    # routed-but-still-QUEUED traffic must not read as misses: routed
+    # without lookups is a no-op window
+    router._route(prompt)  # re-registers
+    for _ in range(5):
+        router._route(prompt)
+    router._adopt_stats(be, reply(5, 7))  # no lookup delta at all
+    assert len(router._affinity) > 0
+    assert router.registry.snapshot()[
+        "serve.router.affinity_decays"]["value"] == 1
+    # MIXED workload: the affinity-routed requests all hit warm, but
+    # least-loaded-routed NEW prefixes cold-missed alongside them —
+    # those misses must not condemn a perfectly accurate table
+    rng2 = np.random.default_rng(2)
+    for _ in range(5):
+        got, affine = router._route(prompt)
+        assert affine
+    for _ in range(12):  # distinct new prefixes -> cold misses
+        router._route(np.concatenate(
+            [_groups(rng2, 1)[0],
+             rng2.integers(0, VOCAB, 3).astype(np.int32)]))
+    router._adopt_stats(be, reply(10, 19))  # +5 hits, +12 misses
+    assert len(router._affinity) > 0, \
+        "cold lookups from new prefixes must not decay valid affinity"
+    assert router.registry.snapshot()[
+        "serve.router.affinity_decays"]["value"] == 1
+
+
+# ---------------------------------------------------------------------------
+# routing through a live fleet
+# ---------------------------------------------------------------------------
+
+def test_affinity_routes_shared_prefixes_above_hash_random(lm):
+    """The tentpole behavior: on a shared-prefix workload the fleet's
+    prefix hit rate holds the single-engine warm level — each group
+    lands on ONE engine (first request cold, the rest warm there) —
+    where hash-random placement would cold-miss every group on every
+    engine it touches.  Outputs stay exactly the offline reference."""
+    groups, per_group, engines = 4, 6, 3
+    rng = np.random.default_rng(7)
+    shared = _groups(rng, groups)
+    servers = _fleet(lm, engines)
+    router = _router(servers)
+    try:
+        with ServeClient("127.0.0.1", router.port) as client:
+            for g in range(groups):
+                for _ in range(per_group):
+                    tail = rng.integers(0, VOCAB, 3).astype(np.int32)
+                    prompt = np.concatenate([shared[g], tail])
+                    reply = client.generate(prompt, 4)
+                    assert reply["ok"], reply
+                    assert np.array_equal(np.asarray(reply["tokens"]),
+                                          _ref(lm, prompt, 4))
+            st = client.stats()
+    finally:
+        _stop_all(router, servers)
+    stats = st["stats"]
+    total = groups * per_group
+    hits = stats["serve.prefix.hits"]["value"]
+    misses = stats["serve.prefix.misses"]["value"]
+    assert hits + misses == total
+    hit_rate = hits / total
+    # affinity keeps every group on one engine: exactly one cold miss
+    # per group — the single-engine warm baseline for this workload
+    assert hit_rate == (total - groups) / total
+    # hash-random placement cold-misses a group once PER ENGINE it
+    # lands on; with 6 requests over 3 engines that expectation is
+    # ~2.6 engines/group -> hit rate <= ~0.57.  Measurably above it:
+    assert hit_rate > 0.6
+    assert stats["serve.router.affinity_hits"]["value"] == total - groups
+    assert stats["serve.router.requests"]["value"] == \
+        stats["serve.router.completed"]["value"] + \
+        stats["serve.router.rejected"]["value"]
+    # the fleet spread: every engine took at least one group
+    reqs = [e["requests"] for e in st["engines"]]
+    assert sorted(reqs) == [6, 12, 18] or min(reqs) >= per_group
+    assert stats["jit.retraces"]["value"] == 0
+
+
+def test_engine_eviction_requeues_to_survivor_with_exact_accounting(lm):
+    """A dead engine's in-flight forward is RE-QUEUED to a survivor —
+    the client sees a completed reply, never a dropped request — the
+    dead engine is evicted with its affinity entries, and the router's
+    ``requests == completed + rejected`` stays exact."""
+    rng = np.random.default_rng(8)
+    shared = _groups(rng, 1)[0]
+    servers = _fleet(lm, 2)
+    router = _router(servers)
+    try:
+        with ServeClient("127.0.0.1", router.port) as client:
+            # pin the group's affinity to whichever engine takes it
+            p0 = np.concatenate([shared,
+                                 rng.integers(0, VOCAB, 3).astype(
+                                     np.int32)])
+            assert client.generate(p0, 4)["ok"]
+            victim_idx = next(i for i, e in
+                              enumerate(client.stats()["engines"])
+                              if e["requests"] == 1)
+            # kill the affine engine: the next request of this group
+            # routes to it, fails, and is re-queued to the survivor
+            servers[victim_idx].stop()
+            p1 = np.concatenate([shared,
+                                 rng.integers(0, VOCAB, 3).astype(
+                                     np.int32)])
+            reply = client.generate(p1, 4)
+            assert reply["ok"], reply
+            assert np.array_equal(np.asarray(reply["tokens"]),
+                                  _ref(lm, p1, 4))
+            st = client.stats()
+    finally:
+        _stop_all(router, servers)
+    stats = st["stats"]
+    assert stats["serve.router.evictions"]["value"] == 1
+    assert stats["serve.router.requeues"]["value"] == 1
+    assert stats["serve.router.requests"]["value"] == 2
+    assert stats["serve.router.requests"]["value"] == \
+        stats["serve.router.completed"]["value"] + \
+        stats["serve.router.rejected"]["value"]
+    dead = [e for e in st["engines"] if not e["alive"]]
+    assert len(dead) == 1
+    assert st["engines_alive"] == 1
+    # the survivor fleet still answers; a fleet with NO survivor sheds
+    # with a recorded rejection instead (no silent drop: tested below)
+
+
+def test_no_survivor_rejects_with_recorded_rejection(lm):
+    rng = np.random.default_rng(9)
+    servers = _fleet(lm, 1)
+    router = _router(servers)
+    try:
+        with ServeClient("127.0.0.1", router.port) as client:
+            prompt = rng.integers(0, VOCAB, 6).astype(np.int32)
+            assert client.generate(prompt, 4)["ok"]
+            servers[0].stop()
+            reply = client.generate(prompt, 4)
+            assert reply["ok"] is False and reply["rejected"]
+            snap = router.registry.snapshot()
+    finally:
+        _stop_all(router, servers)
+    assert snap["serve.router.rejected_no_backend"]["value"] == 1
+    assert snap["serve.router.evictions"]["value"] == 1
+    assert snap["serve.router.requests"]["value"] == \
+        snap["serve.router.completed"]["value"] + \
+        snap["serve.router.rejected"]["value"]
+
+
+def test_fleet_promote_atomicity_one_engine_down_then_rollforward(lm):
+    """ONE ``promote`` through the front door drives the whole fleet —
+    partial failure is reported PER ENGINE (the live ones deploy, the
+    dead one is named), and when the dead engine comes back the poller
+    rolls it forward to the promoted version before traffic lands on
+    it: the fleet converges on the deployed checkpoint."""
+    model, _ = lm
+    v_new = model.init(1)
+    rng = np.random.default_rng(10)
+    prompt = rng.integers(0, VOCAB, 6).astype(np.int32)
+    servers = _fleet(lm, 3)
+    router = _router(servers, stats_interval_s=0.05)
+    down_port = servers[2].port
+    try:
+        servers[2].stop()  # one engine down before the fan-out
+        with ServeClient("127.0.0.1", router.port) as client:
+            reply = client.promote(v_new)
+            assert reply["ok"] is False  # partial: reported, not hidden
+            assert reply["promoted"] == 2 and reply["failed"] == 1
+            per = reply["engines"]
+            assert sum(1 for r in per.values() if r["ok"]) == 2
+            bad = [a for a, r in per.items() if not r["ok"]]
+            assert bad == [f"127.0.0.1:{down_port}"]
+        # the two live engines serve the NEW checkpoint
+        for srv in servers[:2]:
+            with ServeClient("127.0.0.1", srv.port) as c:
+                got = np.asarray(c.generate(prompt, 6)["tokens"])
+                assert np.array_equal(got, _ref(lm, prompt, 6,
+                                                variables=v_new))
+        # the dead engine comes back on the SAME address with OLD
+        # weights: the poller must rejoin it AND roll the promote
+        # forward before declaring it converged
+        servers[2] = ServeServer(_engine(lm), host="127.0.0.1",
+                                 port=down_port).start()
+        deadline = time.monotonic() + 30
+        while router.registry.counter(
+                "serve.router.promote_rollforwards").value < 1:
+            assert time.monotonic() < deadline, "roll-forward never fired"
+            time.sleep(0.02)
+        assert router.registry.counter(
+            "serve.router.rejoins").value == 1
+        with ServeClient("127.0.0.1", down_port) as c:
+            got = np.asarray(c.generate(prompt, 6)["tokens"])
+            assert np.array_equal(got, _ref(lm, prompt, 6,
+                                            variables=v_new))
+    finally:
+        _stop_all(router, servers)
+
+
+def test_v1_pinned_engine_interop(lm):
+    """A legacy v1-pinned engine serves behind the same front door: the
+    router's backend connection negotiates down to v1 for that engine
+    while its siblings (and the router's own clients) ride v2."""
+    servers = [ServeServer(_engine(lm), max_wire_version=1).start(),
+               ServeServer(_engine(lm)).start()]
+    rng = np.random.default_rng(11)
+    shared = _groups(rng, 2)
+    router = _router(servers)
+    try:
+        with ServeClient("127.0.0.1", router.port) as client:
+            assert client.wire_version == 2
+            for g in range(2):      # spread lands one group per engine
+                for _ in range(3):
+                    tail = rng.integers(0, VOCAB, 3).astype(np.int32)
+                    prompt = np.concatenate([shared[g], tail])
+                    reply = client.generate(prompt, 4)
+                    assert reply["ok"], reply
+                    assert np.array_equal(np.asarray(reply["tokens"]),
+                                          _ref(lm, prompt, 4))
+            st = client.stats()
+        # a v1-pinned CLIENT through the router works too
+        with ServeClient("127.0.0.1", router.port,
+                         wire_version=1) as c1:
+            assert c1.wire_version == 1
+            prompt = rng.integers(0, VOCAB, 5).astype(np.int32)
+            reply = c1.generate(prompt, 4)
+            assert reply["ok"]
+            assert np.array_equal(np.asarray(reply["tokens"]),
+                                  _ref(lm, prompt, 4))
+    finally:
+        _stop_all(router, servers)
+    reqs = [e["requests"] for e in st["engines"]]
+    assert sum(reqs) == 6 and min(reqs) == 3  # both engines served
+    assert st["stats"]["jit.retraces"]["value"] == 0
+
+
+def test_router_malformed_fields_keep_accounting_exact(lm):
+    """A malformed FIELD riding the wire (non-numeric max_new_tokens /
+    temperature) answers an error like the engine front-end would — and
+    is COUNTED, so ``serve.router.requests == completed + rejected``
+    survives hostile clients."""
+    from distkeras_tpu.ps.networking import connect, recv_msg, send_msg
+    servers = _fleet(lm, 1)
+    router = _router(servers)
+    try:
+        sock = connect("127.0.0.1", router.port)
+        try:
+            send_msg(sock, {"action": "generate",
+                            "prompt": np.arange(4, dtype=np.int32),
+                            "max_new_tokens": "nope"})
+            resp = recv_msg(sock)
+            assert resp["ok"] is False and "error" in resp
+            send_msg(sock, {"action": "generate",
+                            "prompt": np.arange(4, dtype=np.int32),
+                            "max_new_tokens": 3,
+                            "temperature": float("nan")})
+            resp = recv_msg(sock)
+            assert resp["ok"] is False and \
+                "temperature" in resp["error"]
+            # the connection survived; a well-formed request still works
+            send_msg(sock, {"action": "generate",
+                            "prompt": np.arange(4, dtype=np.int32),
+                            "max_new_tokens": 2})
+            resp = recv_msg(sock)
+            assert resp["ok"] is True and len(resp["tokens"]) == 2
+        finally:
+            sock.close()
+        snap = router.registry.snapshot()
+    finally:
+        _stop_all(router, servers)
+    assert snap["serve.router.requests"]["value"] == 3
+    assert snap["serve.router.rejected_error"]["value"] >= 1
+    assert snap["serve.router.requests"]["value"] == \
+        snap["serve.router.completed"]["value"] + \
+        snap["serve.router.rejected"]["value"]
+
+
+def test_router_drain_stops_admission_and_fans_out(lm):
+    servers = _fleet(lm, 2)
+    router = _router(servers)
+    try:
+        with ServeClient("127.0.0.1", router.port) as client:
+            prompt = np.arange(5, dtype=np.int32)
+            assert client.generate(prompt, 4)["ok"]
+            reply = client.drain(timeout_s=30)
+            assert reply["ok"]
+            assert all(r.get("ok") for r in reply["engines"].values())
+            shed = client.generate(prompt, 4)
+            assert shed["ok"] is False and shed["reason"] == "draining"
+            st = client.stats()
+    finally:
+        _stop_all(router, servers)
+    assert st["draining"] is True
+    assert all(e.get("draining") for e in st["engines"])
+    snap = st["stats"]
+    assert snap["serve.router.rejected_draining"]["value"] == 1
+    assert snap["serve.router.requests"]["value"] == \
+        snap["serve.router.completed"]["value"] + \
+        snap["serve.router.rejected"]["value"]
+
+
+# ---------------------------------------------------------------------------
+# acceptance: fleet steady state, drift-gated
+# ---------------------------------------------------------------------------
+
+def test_fleet_acceptance_mixed_sampling_retraces_zero_drift_gated(lm):
+    """Acceptance: concurrent mixed traffic — shared-prefix groups,
+    per-request temperatures (greedy rows verified against the offline
+    reference MID-BATCH with sampled rows), warm joins — through a
+    3-engine fleet holds ``jit.retraces == 0`` fleet-wide, gated by the
+    committed OBS_BASELINE.json zero-tolerance rule."""
+    engines = 3
+    rng = np.random.default_rng(12)
+    shared = _groups(rng, engines)
+    servers = _fleet(lm, engines)
+    router = _router(servers, stats_interval_s=0.1)
+    errors: list = []
+
+    def drive(k: int) -> None:
+        try:
+            with ServeClient("127.0.0.1", router.port) as client:
+                for i in range(4):
+                    tail = np.asarray([k, i, (k + i) % VOCAB], np.int32)
+                    prompt = np.concatenate([shared[k % engines], tail])
+                    if i % 2:
+                        # sampled request: valid tokens, correct count
+                        reply = client.generate(prompt, 4,
+                                                temperature=0.8,
+                                                top_p=0.9)
+                        assert reply["ok"], reply
+                        toks = np.asarray(reply["tokens"])
+                        assert toks.shape == (4,)
+                        assert ((0 <= toks) & (toks < VOCAB)).all()
+                    else:
+                        # greedy request: exact offline parity even
+                        # while sampled rows share its batch
+                        reply = client.generate(prompt, 4)
+                        assert reply["ok"], reply
+                        assert np.array_equal(
+                            np.asarray(reply["tokens"]),
+                            _ref(lm, prompt, 4))
+        except BaseException as e:
+            errors.append(e)
+
+    try:
+        threads = [threading.Thread(target=drive, args=(k,))
+                   for k in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        with ServeClient("127.0.0.1", router.port) as client:
+            st = client.stats()
+    finally:
+        _stop_all(router, servers)
+    stats = st["stats"]
+    assert stats["serve.router.completed"]["value"] == 24
+    assert stats["jit.retraces"]["value"] == 0
+    assert stats["serve.router.requests"]["value"] == \
+        stats["serve.router.completed"]["value"] + \
+        stats["serve.router.rejected"]["value"]
+    # the drift gate: identical fleet snapshots are clean; one retrace
+    # over the committed zero-tolerance rule is DRIFT
+    baseline = drift.load_baseline(os.path.join(_ROOT,
+                                                "OBS_BASELINE.json"))
+    doc = {"config": {"mode": "serve_fleet"}, "fleet": stats}
+    report = drift.diff_docs(doc, copy.deepcopy(doc), baseline=baseline)
+    assert not report.drifted
+    bumped = copy.deepcopy(doc)
+    bumped["fleet"]["jit.retraces"]["value"] += 1
+    report = drift.diff_docs(doc, bumped, baseline=baseline)
+    assert any(m.endswith("jit.retraces") for m in report.drifted_metrics)
+
+
+# ---------------------------------------------------------------------------
+# obsview fleet view
+# ---------------------------------------------------------------------------
+
+def _load_obsview():
+    spec = importlib.util.spec_from_file_location(
+        "obsview", os.path.join(_ROOT, "scripts", "obsview.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_obsview_router_poll_renders_fleet_sections(lm):
+    obsview = _load_obsview()
+    rng = np.random.default_rng(13)
+    shared = _groups(rng, 2)
+    servers = _fleet(lm, 2)
+    router = _router(servers)
+    try:
+        with ServeClient("127.0.0.1", router.port) as client:
+            for g in range(2):
+                for _ in range(3):
+                    tail = rng.integers(0, VOCAB, 3).astype(np.int32)
+                    assert client.generate(
+                        np.concatenate([shared[g], tail]), 4)["ok"]
+        out = obsview.summarize_serve(
+            obsview.poll_serve("127.0.0.1", router.port))
+        # the comma-separated engine-list mode renders the same panels
+        replies = [obsview.poll_serve("127.0.0.1", s.port)
+                   for s in servers]
+        fleet = obsview.summarize_serve(
+            obsview.merge_serve_replies(replies))
+    finally:
+        _stop_all(router, servers)
+    assert "== Router ==" in out
+    assert "== Engine balance ==" in out
+    assert "engines alive: 2" in out
+    assert "MISROUTED" not in out  # warm fleet holds the baseline
+    assert "RETRACING" not in out
+    assert "×2 engines" in fleet
+    assert "== Engine balance ==" in fleet
+    assert "MISROUTED" not in fleet
+    # parse_serve_targets: fleet lists and routers share the flag
+    assert obsview.parse_serve_targets("a:1,b:2") == [("a", 1), ("b", 2)]
+    with pytest.raises(ValueError):
+        obsview.parse_serve_targets("nonsense")
+
+
+def test_obsview_misrouted_alarm_on_trailing_hit_rate():
+    """A fleet whose merged prefix hit rate trails the single-engine
+    baseline renders MISROUTED; a healthy fleet must not."""
+    obsview = _load_obsview()
+
+    def reply(hits, misses):
+        return {"server": "ServeServer", "slots": 2,
+                "stats": {
+                    "serve.prefix.hits":
+                        {"type": "counter", "value": hits},
+                    "serve.prefix.misses":
+                        {"type": "counter", "value": misses},
+                    "serve.requests":
+                        {"type": "counter", "value": hits + misses}}}
+
+    healthy = obsview.summarize_serve(obsview.merge_serve_replies(
+        [reply(20, 2), reply(18, 4)]))
+    assert "MISROUTED" not in healthy
+    misrouted = obsview.summarize_serve(obsview.merge_serve_replies(
+        [reply(3, 19), reply(2, 20)]))
+    assert "MISROUTED" in misrouted
+    # a single engine never alarms (there is nothing to misroute)
+    single = obsview.summarize_serve(obsview.merge_serve_replies(
+        [reply(3, 19)]))
+    assert "MISROUTED" not in single
