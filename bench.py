@@ -923,6 +923,15 @@ def bench_ps(codec: str = "none", windows: int = 50, mb: float = 4.0,
     DOWN-direction bytes/window plus the reference-residual compression
     ratio.
 
+    ISSUE 15 (wire round 3): the single-worker point additionally runs a
+    **streaming A/B phase** — a monolithic reference pass (streaming
+    refused per client, fresh pull == full blocking RTT) then a streamed
+    dispatch-ahead pass (``pull_begin`` before a simulated compute window
+    sized at the monolithic p50, ``pull_join`` after) — reporting
+    ``pull_hidden_fraction`` (share of fresh-pull wall time hidden behind
+    the compute window) and fresh-pull-to-first-dispatch p50 for both
+    sides in one committed snapshot.
+
     Returns (and the CLI prints) one JSON row: median/p99 commit AND pull
     RTT across all workers, wire bytes per window (direction-tagged),
     compression ratios.  One MERGED registry snapshot per sweep point is
@@ -988,18 +997,86 @@ def bench_ps(codec: str = "none", windows: int = 50, mb: float = 4.0,
     negotiated = [1] * ps_workers
     errors: list = []
 
-    def make_client(k: int, use_shm: bool):
+    stream_ab: dict = {}
+
+    def make_client(k: int, use_shm: bool, use_stream=None):
         # explicit bool: False must DISABLE shm even under DKTPU_SHM=1,
         # or the TCP reference phase of an --shm A/B silently negotiates
-        # rings and measures shm against itself
+        # rings and measures shm against itself (use_stream likewise for
+        # the streaming A/B's monolithic reference phase — ISSUE 15)
         if sharded is not None:
             return ShardedPSClient(sharded.addrs(), center, k,
                                    registry=regs[k], codec=codec,
                                    wire_version=wire_version, down=down,
-                                   shm=use_shm)
+                                   shm=use_shm, stream=use_stream)
         return PSClient("127.0.0.1", server.port, k, registry=regs[k],
                         codec=codec, wire_version=wire_version, down=down,
-                        shm=use_shm)
+                        shm=use_shm, stream=use_stream)
+
+    def drive_stream_ab(k: int, creg) -> None:
+        """Streaming A/B (ISSUE 15), single-worker point only: a
+        monolithic reference pass (stream refused client, fresh pulls,
+        pull == dispatch wait), then a streamed dispatch-ahead pass —
+        ``pull_begin`` before a simulated compute window sized at the
+        monolithic pull p50, ``pull_join`` after — so ONE committed
+        snapshot carries both sides of pull-to-first-dispatch and the
+        measured hidden fraction."""
+        h_mono = creg.histogram("bench.ps.pull_to_dispatch_seconds_mono",
+                                TIME_BUCKETS)
+        h_stream = creg.histogram(
+            "bench.ps.pull_to_dispatch_seconds_stream", TIME_BUCKETS)
+        mono_rtts = []
+        with make_client(k, use_shm=False, use_stream=False) as mono:
+            mono.pull()  # connection + first transfer warm
+            for _ in range(max(8, windows // 4)):
+                # calibration: the simulated compute window is sized at
+                # the monolithic pull p50, so "hidden behind compute"
+                # means hidden behind a window the pull itself would fill
+                mono.invalidate()
+                t0 = time.perf_counter()
+                mono.pull()
+                mono_rtts.append(time.perf_counter() - t0)
+            compute_s = float(np.median(mono_rtts))
+            mono_rtts = []
+            hidden_s = wall_s = 0.0
+            waits = []
+            with make_client(k, use_shm=False, use_stream=True) as sc:
+                sc.pull()
+                subs = getattr(sc, "clients", None)
+                active = all(c.stream_enabled for c in subs) if subs \
+                    else bool(getattr(sc, "stream_enabled", False))
+                # the two sides run INTERLEAVED (not pass-after-pass):
+                # localhost RTTs drift with host load over a pass, and a
+                # sequential A then B would measure the drift, not the
+                # streaming
+                for _ in range(windows):
+                    mono.invalidate()
+                    t0 = time.perf_counter()
+                    mono.pull()
+                    dt = time.perf_counter() - t0
+                    mono_rtts.append(dt)
+                    h_mono.observe(dt)
+                    sc.invalidate()
+                    t0 = time.perf_counter()
+                    sc.pull_begin()
+                    time.sleep(compute_s)  # the simulated device window
+                    t1 = time.perf_counter()
+                    sc.pull_join()
+                    t2 = time.perf_counter()
+                    hidden_s += t1 - t0
+                    wall_s += t2 - t0
+                    waits.append(t2 - t1)
+                    h_stream.observe(t2 - t1)
+        mono_p50 = float(np.median(mono_rtts))
+        stream_p50 = float(np.median(waits))
+        stream_ab.update({
+            "stream": active,
+            "pull_hidden_fraction": round(hidden_s / max(wall_s, 1e-12),
+                                          3),
+            "pull_to_dispatch_ms_p50_mono": round(mono_p50 * 1e3, 3),
+            "pull_to_dispatch_ms_p50_stream": round(stream_p50 * 1e3, 3),
+            "stream_speedup": round(mono_p50 / max(stream_p50, 1e-12), 2),
+        })
 
     def drive(k: int) -> None:
         try:
@@ -1009,6 +1086,11 @@ def bench_ps(codec: str = "none", windows: int = 50, mb: float = 4.0,
             h_pull = creg.histogram("bench.ps.pull_seconds", TIME_BUCKETS)
             h_commit = creg.histogram("bench.ps.commit_seconds",
                                       TIME_BUCKETS)
+            # pre-created so 0 is present even when no link downshifts
+            # (or no adaptive policy) ever fire
+            creg.counter("ps.link.downshifts")
+            if ps_workers == 1 and not shm:
+                drive_stream_ab(k, creg)
             if shm:
                 # A/B reference phase (ISSUE 12): the SAME pull-heavy
                 # workload over plain TCP first, into its own histogram,
@@ -1145,6 +1227,11 @@ def bench_ps(codec: str = "none", windows: int = 50, mb: float = 4.0,
         "down_compression_ratio": round(down_raw / down_enc, 3)
         if down_enc else 1.0,
         "bytes_saved": _counter(merged, "ps.codec.bytes_saved"),
+        #: streaming A/B (ISSUE 15), single-worker point: hidden fraction
+        #: + pull-to-first-dispatch p50 both sides, from drive_stream_ab
+        **stream_ab,
+        **({"stream_chunks": _counter(merged, "ps.pull.stream_chunks")}
+           if stream_ab else {}),
     }
     # the single-worker snapshot name follows OBS_BASELINE.json's
     # ``snapshots.ps_bench`` mapping so a remapped baseline is both

@@ -1,6 +1,6 @@
 """dklint rules — repo-specific static checks for a distributed-JAX stack.
 
-Seven rules, each targeting a hazard class this codebase actually has
+Eight rules, each targeting a hazard class this codebase actually has
 (ISSUE 3; the PS stack is exactly the shape of code where these corrupt
 training without failing a test):
 
@@ -35,6 +35,13 @@ training without failing a test):
   creator's unlink() releases the /dev/shm backing, so a leak persists
   until reboot.  Attach-only scopes (which must NOT unlink — the
   creator owns that) are not flagged.
+* ``wire-seam`` — raw ``.recv(`` / ``.recv_into(`` / ``.sendall(`` /
+  ``.sendmsg(`` calls outside ``ps/networking.py`` (ISSUE 15): every
+  wire byte must travel the one networking seam — it carries the
+  v1/v2/shm/stream framing, the chaos fault-injection hook, and the
+  ``net.*`` byte counters.  A raw socket call elsewhere ships bytes the
+  fault harness cannot reset, the byte ledgers never see, and the frame
+  auto-detection cannot parse.
 """
 
 from __future__ import annotations
@@ -755,6 +762,43 @@ class ShmLifecycleRule(Rule):
         return False
 
 
+# ---------------------------------------------------------------------------
+# wire-seam
+# ---------------------------------------------------------------------------
+
+
+class WireSeamRule(Rule):
+    id = "wire-seam"
+    description = ("raw socket recv()/sendall() outside ps/networking.py "
+                   "— bypasses the zero-copy / fault-hook / byte-counter "
+                   "wire seam")
+
+    #: the methods that move bytes on a socket; attribute-call matching
+    #: by name (the house style — bare-print, staleness-protocol), with
+    #: the pragma as the escape hatch for a non-socket receiver
+    _METHODS = ("recv", "recv_into", "sendall", "sendmsg")
+    _SEAM = "ps/networking.py"
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        rel = ctx.rel.replace("\\", "/")
+        if rel.endswith(self._SEAM) or rel == "networking.py":
+            return []  # the seam itself is the one legitimate caller
+        return [
+            self.finding(
+                ctx, node,
+                f"raw socket .{node.func.attr}() outside ps/networking.py "
+                "— every wire byte must travel the networking seam "
+                "(v1/v2/shm/stream frame detection, the chaos fault "
+                "hook, the net.* byte counters); use send_msg/recv_msg/"
+                "send_packed/send_stream/recv_pull instead, or disable "
+                "with a pragma if the receiver is not a socket")
+            for node in ast.walk(ctx.tree)
+            if isinstance(node, ast.Call) and
+            isinstance(node.func, ast.Attribute) and
+            node.func.attr in self._METHODS
+        ]
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     JitPurityRule(),
     LockDisciplineRule(),
@@ -763,6 +807,7 @@ ALL_RULES: Tuple[Rule, ...] = (
     BarePrintRule(),
     StalenessProtocolRule(),
     ShmLifecycleRule(),
+    WireSeamRule(),
 )
 
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}

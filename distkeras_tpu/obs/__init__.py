@@ -40,7 +40,11 @@ from .registry import (  # noqa: F401
 from .spans import SpanTracer, default_tracer, set_default_sink, span  # noqa: F401
 from .exposition import to_prometheus_text  # noqa: F401
 from .logging import emit, enable_stderr_logging, get_logger  # noqa: F401
-from .stragglers import StragglerDetector, detect_from_heartbeats  # noqa: F401
+from .stragglers import (  # noqa: F401
+    LinkQuality,
+    StragglerDetector,
+    detect_from_heartbeats,
+)
 from .profile import (  # noqa: F401
     ProfileConfig,
     RetraceSentinel,

@@ -18,6 +18,16 @@ per-window heartbeat cadence the workers already emit into a live signal:
   JSONL heartbeat stream (records carrying ``worker_id``/``gap_s``) — the
   post-mortem path ``scripts/obsview.py`` uses on run files.
 
+* ``LinkQuality`` (ISSUE 15) is the **link half** of the same picture,
+  living on the CLIENT next to the adaptive DOWN-codec policy: per-link
+  pull/commit RTT EWMAs with a degradation edge against the best RTT the
+  link has shown.  The adaptive policy consumes ``degraded()`` to
+  downshift the codec (and tighten its reprobe schedule) BEFORE the
+  worker's stretched window gap gets it flagged here, and the client
+  ships its EWMA on every commit (``link_rtt_s``) so the server-side
+  detector's snapshot renders gap and link side by side — a stretched
+  gap whose link stretched equally is wire-degraded, not compute-stuck.
+
 Thresholding is median-relative, not absolute: window wall time is
 workload-dependent, but the *fleet* trains identical windows, so a worker
 k× slower than the median is anomalous at any absolute scale.  The
@@ -52,6 +62,99 @@ def _loo_median(vals_sorted: Sequence[float], i: int) -> float:
     return (at(m // 2 - 1) + at(m // 2)) / 2.0
 
 
+class LinkQuality:
+    """Per-link RTT EWMAs (pull + commit) with a degradation edge
+    (ISSUE 15).  One instance per PS connection, on the CLIENT — the end
+    that actually measures the link.
+
+    The pull EWMA folds the VISIBLE pull wait (blocked-on-reply ->
+    decoded): for a sequential pull that is the wire RTT; for a
+    dispatch-ahead pull it is the drain left after compute — the pull's
+    critical-path cost either way, and deliberately NOT the
+    send-to-decode span, which under overlap would count the caller's
+    whole device step as link time.  The commit EWMA is a full
+    synchronous wire RTT.  Either direction's degradation trips the
+    edge.
+
+    ``degraded()`` is True while either direction's EWMA exceeds
+    ``degrade_factor`` × the best EWMA that direction has shown (floored
+    at ``min_rtt_s`` so toy-fast links never read as degraded).  After a
+    consumer ACTS on the edge (the adaptive policy's codec downshift),
+    :meth:`rebase` adopts the current EWMAs as the new baseline — the
+    link's byte profile just changed, so the old best is no longer the
+    comparison point (and the edge self-cools instead of re-firing every
+    pull).  Thread-safe; hostile inputs (NaN, negative) are rejected
+    before they can poison an EWMA."""
+
+    def __init__(self, alpha: float = 0.25, degrade_factor: float = 2.5,
+                 min_rtt_s: float = 1e-3, registry=None):
+        if degrade_factor <= 1.0:
+            raise ValueError(f"degrade_factor must exceed 1, "
+                             f"got {degrade_factor}")
+        self.alpha = float(alpha)
+        self.degrade_factor = float(degrade_factor)
+        self.min_rtt_s = float(min_rtt_s)
+        self.registry = registry
+        self._lock = threading.Lock()
+        self._ewma: Dict[str, Optional[float]] = {"pull": None,
+                                                  "commit": None}
+        self._best: Dict[str, Optional[float]] = {"pull": None,
+                                                  "commit": None}
+
+    def _fold(self, kind: str, rtt_s) -> None:
+        try:
+            r = float(rtt_s)
+        except (TypeError, ValueError):
+            return
+        if not math.isfinite(r) or r < 0:
+            return
+        with self._lock:
+            prev = self._ewma[kind]
+            cur = r if prev is None \
+                else self.alpha * r + (1.0 - self.alpha) * prev
+            self._ewma[kind] = cur
+            best = self._best[kind]
+            if best is None or cur < best:
+                self._best[kind] = cur
+        if self.registry is not None:
+            self.registry.gauge(f"ps.link.{kind}_rtt_ewma").set(cur)
+
+    def observe_pull(self, rtt_s) -> None:
+        self._fold("pull", rtt_s)
+
+    def observe_commit(self, rtt_s) -> None:
+        self._fold("commit", rtt_s)
+
+    @property
+    def ewma(self) -> Optional[float]:
+        """The link's representative RTT EWMA — the pull direction when
+        it has samples (pulls carry the center, the dominant bytes),
+        else the commit direction."""
+        with self._lock:
+            return self._ewma["pull"] if self._ewma["pull"] is not None \
+                else self._ewma["commit"]
+
+    def degraded(self) -> bool:
+        with self._lock:
+            return any(
+                e is not None and b is not None
+                and e > self.degrade_factor * max(b, self.min_rtt_s)
+                for e, b in ((self._ewma[k], self._best[k])
+                             for k in ("pull", "commit")))
+
+    def rebase(self) -> None:
+        """Adopt the current EWMAs as the new baseline (called after a
+        consumer acted on the degradation edge)."""
+        with self._lock:
+            for k in ("pull", "commit"):
+                self._best[k] = self._ewma[k]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"ewma_s": dict(self._ewma), "best_s": dict(self._best),
+                    "degrade_factor": self.degrade_factor}
+
+
 class StragglerDetector:
     """Rolling heartbeat-gap EWMA per worker, fleet-median flagging.
 
@@ -83,6 +186,12 @@ class StragglerDetector:
         self._lock = threading.Lock()
         self._ewma: Dict[int, float] = {}
         self._flagged: set = set()   # currently over threshold
+        #: per-worker link RTT EWMAs + codec-downshift tallies shipped on
+        #: the commit RPC (ISSUE 15) — already EWMAs client-side, so the
+        #: latest value wins; rendered next to the gap EWMAs so the
+        #: numbers that justify (or excuse) a flag sit side by side
+        self._link: Dict[int, float] = {}
+        self._link_downshifts: Dict[int, int] = {}
         self._log = get_logger("obs.stragglers")
 
     def record(self, worker_id, gap_s) -> bool:
@@ -148,6 +257,28 @@ class StragglerDetector:
                     f"ps.heartbeat_gap_ewma.worker{w}").set(e)
         return set(self._flagged)
 
+    def record_link(self, worker_id, rtt_s, downshifts=None) -> None:
+        """Fold one worker's reported link RTT EWMA (the commit RPC's
+        ``link_rtt_s`` field — ISSUE 15) and, when present, its
+        cumulative codec-downshift count.  Hostile values are rejected
+        like ``record``'s ``gap_s``."""
+        try:
+            w = int(worker_id)
+            r = float(rtt_s)
+        except (TypeError, ValueError):
+            return
+        if not math.isfinite(r) or r < 0:
+            return
+        with self._lock:
+            self._link[w] = r
+            if downshifts is not None:
+                try:
+                    self._link_downshifts[w] = int(downshifts)
+                except (TypeError, ValueError):
+                    pass
+        if self.registry is not None:
+            self.registry.gauge(f"ps.link.rtt_ewma.worker{w}").set(r)
+
     def commit_weight(self, worker_id) -> float:
         """DynSGD-style down-weighting multiplier for this worker's NEXT
         commit (ISSUE 9 rung 1): an unflagged worker commits at full
@@ -184,8 +315,13 @@ class StragglerDetector:
         with self._lock:
             ewma = dict(self._ewma)
             flagged = sorted(self._flagged)
+            link = dict(self._link)
+            downshifts = dict(self._link_downshifts)
         return {"k": self.k, "alpha": self.alpha,
                 "min_gap_s": self.min_gap_s,
+                "link_rtt_s": {str(w): link[w] for w in sorted(link)},
+                "link_downshifts": {str(w): downshifts[w]
+                                    for w in sorted(downshifts)},
                 "gap_ewma_s": {str(w): ewma[w] for w in sorted(ewma)},
                 "peer_median_s": {
                     str(w): statistics.median(
@@ -210,4 +346,8 @@ def detect_from_heartbeats(records, k: float = 3.0, alpha: float = 0.25,
         w = r.get("worker_id", r.get("worker"))
         if w is not None:
             det.record(w, r["gap_s"])
+            if r.get("link_rtt_s") is not None:
+                # the heartbeat-borne link half (ISSUE 15) replays too
+                det.record_link(w, r["link_rtt_s"],
+                                r.get("link_downshifts"))
     return det.snapshot()

@@ -32,6 +32,18 @@ layered on:
   can actually attach them; v2 tensor segments then skip TCP entirely.
   Refused negotiations (cross-host peers, old servers) silently stay on
   TCP; this end owns the rings and unlinks them on close/reconnect;
+* **streamed pulls** (ISSUE 15) — on by default when the server acks the
+  hello offer (``stream=False`` or ``DKTPU_STREAM=0`` opts out): a fresh
+  pull's reply arrives as self-describing chunk frames decoded as they
+  land, and the split-phase ``pull_begin``/``pull_join`` surface lets a
+  dispatch-ahead worker hide the whole transfer behind its device step —
+  measured per pull into ``ps.pull.hidden_seconds`` and the running
+  ``ps.pull.overlap_fraction`` gauge;
+* **link quality** (ISSUE 15) — every fresh pull/commit RTT feeds a
+  per-link :class:`~..obs.stragglers.LinkQuality` EWMA pair whose
+  degradation edge drives the adaptive policy's codec downshifts
+  (recorded ``ps.link.downshifts``) and rides each commit as
+  ``link_rtt_s`` for the server-side straggler detector's link table;
 * **trace propagation** (ISSUE 5) — with a ``tracer``, pull/commit run
   inside ``ps.pull``/``ps.commit`` spans and, on v2 connections, ship the
   open span's ``(trace_id, parent_span)`` as a ``trace`` header so the
@@ -57,18 +69,23 @@ import os
 import time
 from typing import Any, Optional
 
-from ..obs import TIME_BUCKETS, Registry, default_registry
+from ..obs import TIME_BUCKETS, LinkQuality, Registry, default_registry
 from ..obs.logging import get_logger
 from ..obs.spans import SpanTracer
 from . import codecs
-from .networking import (SHM_RING_MB, ShmChannel, ShmRing, client_handshake,
-                         connect, pinned_wire_version, recv_msg,
-                         retry_with_backoff, send_msg)
+from .networking import (SHM_RING_MB, STREAM_CHUNK_BYTES, ShmChannel,
+                         ShmRing, client_handshake, connect,
+                         pinned_wire_version, recv_msg, recv_pull,
+                         retry_with_backoff, send_msg, stream_enabled_env)
 
 #: direction-tagged wire counters (ISSUE 12): on the worker side, sends
 #: are UP (commits/requests) and receives are DOWN (pulled centers)
 _UP = "ps.wire.bytes_up"
 _DOWN = "ps.wire.bytes_down"
+
+#: streamed-pull chunk-size histogram buckets (bytes)
+_CHUNK_BUCKETS = (1 << 12, 1 << 14, 1 << 16, 1 << 18, 1 << 20, 1 << 21,
+                  1 << 22, 1 << 23, 1 << 24)
 
 
 class WorkerEvicted(RuntimeError):
@@ -85,7 +102,9 @@ class PSClient:
                  tracer: Optional[SpanTracer] = None,
                  generation: int = 0, down=None,
                  shm: Optional[bool] = None,
-                 shm_mb: Optional[float] = None):
+                 shm_mb: Optional[float] = None,
+                 stream: Optional[bool] = None,
+                 stream_chunk_bytes: Optional[int] = None):
         self.worker_id = int(worker_id)
         #: commit generation this incarnation runs under (ISSUE 9):
         #: stamped on every commit so a post-eviction zombie's deltas
@@ -144,6 +163,39 @@ class PSClient:
             else os.environ.get("DKTPU_SHM") == "1"
         self.shm_mb = float(shm_mb) if shm_mb is not None else SHM_RING_MB
         self.shm_active = False
+        #: streamed pulls (ISSUE 15): on by default (``DKTPU_STREAM=0``
+        #: or ``stream=False`` opts out), active only after the server
+        #: acks the hello offer — old/pinned/disabled peers keep the
+        #: monolithic reply, bit-identical on the wire
+        self.stream_requested = stream_enabled_env() if stream is None \
+            else bool(stream)
+        self.stream_chunk_bytes = int(stream_chunk_bytes) \
+            if stream_chunk_bytes is not None else STREAM_CHUNK_BYTES
+        self.stream_enabled = False
+        self._c_streams = self.registry.counter("ps.pull.streams")
+        self._c_stream_chunks = self.registry.counter(
+            "ps.pull.stream_chunks")
+        self._h_chunk_bytes = self.registry.histogram(
+            "ps.pull.chunk_bytes", _CHUNK_BUCKETS)
+        #: overlap accounting (ISSUE 15): how much of each fresh pull's
+        #: wall time passed BEFORE this end started waiting on the reply
+        #: (= transfer hidden behind whatever the caller did between
+        #: ``pull_send`` and ``pull_finish`` — the worker's device step)
+        self._h_hidden = self.registry.histogram("ps.pull.hidden_seconds",
+                                                 TIME_BUCKETS)
+        self._g_overlap = self.registry.gauge("ps.pull.overlap_fraction")
+        self._hidden_total = 0.0
+        self._pull_wall_total = 0.0
+        #: per-link RTT EWMAs with a degradation edge (ISSUE 15) — feeds
+        #: the adaptive DOWN policy's downshift/reprobe schedule and
+        #: rides every commit as ``link_rtt_s`` for the server-side
+        #: straggler detector's link table
+        self.link = LinkQuality(registry=self.registry)
+        #: bounded receive-arena pool for streamed pulls (ISSUE 15):
+        #: steady state reuses the previous-but-one pull's arena once
+        #: its leaves died, so a streaming client performs zero large
+        #: allocations per pull
+        self._pull_scratch: list = []
         self._chan = None
         self.sock = connect(host, port)
         self._handshake()
@@ -181,6 +233,8 @@ class PSClient:
             extras["down"] = {"codecs": list(codecs.DOWN_CODECS)}
         rings = None
         pinned = pinned_wire_version(self._want_version)
+        if self.stream_requested and (pinned is None or pinned >= 2):
+            extras["stream"] = {"chunk_bytes": self.stream_chunk_bytes}
         if self.shm_requested and (pinned is None or pinned >= 2):
             # a v1-pinned connection sends no hello: creating (and
             # immediately unlinking) 2 x shm_mb of /dev/shm per dial
@@ -206,11 +260,16 @@ class PSClient:
         self.down_enabled = (self.down_spec != "none"
                              and self.wire_version >= 2
                              and bool((info.get("down") or {}).get("ok")))
+        self.stream_enabled = (self.stream_requested
+                               and self.wire_version >= 2
+                               and bool((info.get("stream") or {}).get("ok")))
         if self.down_enabled and self.down_spec == "adaptive" \
                 and self._down_policy is None:
             # the policy survives reconnects: its EWMAs describe the
-            # LINK, which is the same network path either way
-            self._down_policy = codecs.AdaptiveDownPolicy(self.registry)
+            # LINK, which is the same network path either way (the
+            # LinkQuality edge rides along for the same reason)
+            self._down_policy = codecs.AdaptiveDownPolicy(self.registry,
+                                                          link=self.link)
         self.shm_active = False
         self._chan = self.sock
         if rings is not None:
@@ -347,6 +406,8 @@ class PSClient:
             if self._down_ref is not None:
                 d["ref_epoch"] = int(self._down_ref[0])
             msg["down"] = d
+        if self.stream_enabled:
+            msg["stream"] = {"chunk_bytes": self.stream_chunk_bytes}
         return msg
 
     def pull_send(self, min_updates: Optional[int] = None) -> None:
@@ -368,14 +429,33 @@ class PSClient:
         consistent-cut pull compares across shards) and its plan epoch;
         plain servers leave both None.  An ``unchanged`` answer reuses
         the cached center/vv/epoch — they can only change when the
-        counter does."""
-        resp = recv_msg(self._chan, registry=self.registry, count_as=_DOWN)
-        self._h_rtt.observe(time.perf_counter() - self._t_pull)
+        counter does.
+
+        A streamed reply (ISSUE 15) is auto-detected per message: the
+        chunks decode as they land (into the same zero-copy ``recv_into``
+        buffers a monolithic v2 frame uses) and the per-chunk sizes feed
+        ``ps.pull.stream_chunks`` / ``ps.pull.chunk_bytes``.  Every
+        fresh pull also records how much of its wall time passed before
+        this call started waiting (``ps.pull.hidden_seconds`` — the
+        transfer a dispatch-ahead worker hid behind its device step) and
+        the running ``ps.pull.overlap_fraction`` gauge."""
+        t_wait = time.perf_counter()
+        resp, chunks = recv_pull(self._chan, registry=self.registry,
+                                 count_as=_DOWN,
+                                 scratch=self._pull_scratch)
+        # rtt_seconds keeps its "what this RPC cost the caller" meaning
+        # under overlap: measured from the WAIT start, not the send — an
+        # overlapped pull's device step must not read as wire latency
+        # (identical to the old span for sequential pulls, where the
+        # wait starts right after the send)
+        self._h_rtt.observe(time.perf_counter() - t_wait)
         self._raise_on_error("pull", resp)
         updates = int(resp["updates"])
         if resp.get("unchanged"):
             # unchanged replies are codec-free and near-instant: never
             # fold their RTT into the adaptive policy's per-codec EWMAs
+            # (nor the link EWMA — a no-payload RTT would bias the
+            # degradation baseline toward zero)
             if self._last_pull is not None:
                 self._c_unchanged.inc()
                 return (self._last_pull[0], updates,
@@ -383,18 +463,47 @@ class PSClient:
             # the cache was invalidated mid-exchange (a reconnect dropped
             # it, but a stale ``have`` was resent): ask again
             # unconditionally for the full center
-            resp = self._rpc(self._pull_msg())
+            send_msg(self._chan, self._pull_msg(), registry=self.registry,
+                     version=self.wire_version, count_as=_UP)
+            resp, chunks = recv_pull(self._chan, registry=self.registry,
+                                     count_as=_DOWN,
+                                     scratch=self._pull_scratch)
             self._raise_on_error("pull", resp)
             updates = int(resp["updates"])
         center = self._decode_down(resp)
+        t_done = time.perf_counter()
+        if chunks is not None:
+            self._c_streams.inc()
+            self._c_stream_chunks.inc(len(chunks))
+            for n in chunks:
+                self._h_chunk_bytes.observe(n)
+        # overlap accounting over fresh pulls only: hidden = in-flight
+        # time before this end blocked on the reply
+        hidden = max(0.0, t_wait - self._t_pull)
+        total = max(t_done - self._t_pull, 1e-9)
+        self._h_hidden.observe(hidden)
+        self._hidden_total += hidden
+        self._pull_wall_total += total
+        self._g_overlap.set(self._hidden_total / self._pull_wall_total)
+        # the link/codec EWMAs are fed the VISIBLE wait (blocked ->
+        # decoded), never send->decoded: for a sequential pull the two
+        # coincide, but an overlapped pull's span includes the caller's
+        # whole device step — folding that in would read healthy links
+        # as degraded, downshift codecs for no wire reason, and report
+        # compute time as link RTT.  The visible wait is exactly the
+        # pull's critical-path cost in either mode, so the EWMAs stay
+        # comparable and a degraded link still shows (more bytes left
+        # to drain after compute).
+        wait_s = max(t_done - t_wait, 1e-9)
+        self.link.observe_pull(wait_s)
         if self._down_policy is not None and self._down_req is not None:
-            # measured AFTER decode: the per-codec EWMAs must fold in
+            # measured to AFTER decode: the per-codec EWMAs must fold in
             # this end's decode cost, or a heavy-decode codec looks
             # cheaper than it is end to end
             self._down_policy.observe(
                 (resp.get("down") or {}).get("codec", "none")
                 if isinstance(resp.get("down"), dict) else "none",
-                time.perf_counter() - self._t_pull)
+                wait_s)
         vv = resp.get("vv")
         if isinstance(vv, dict):
             vv = {int(k): int(v) for k, v in vv.items()}
@@ -445,6 +554,31 @@ class PSClient:
                 self.pull_send()
                 return self.pull_finish()
 
+    # -- overlapped pulls (ISSUE 15) ----------------------------------------
+    def pull_begin(self, min_updates: Optional[int] = None) -> None:
+        """Phase 1 of an OVERLAPPED pull, with the idempotent-read
+        reconnect: the dispatch-ahead worker issues this right after its
+        device step is dispatched, so the center transfer rides the wire
+        while the device computes; :meth:`pull_join` collects it."""
+        try:
+            self.pull_send(min_updates)
+        except (ConnectionError, OSError):
+            self.reconnect()
+            self.pull_send(min_updates)
+
+    def pull_join(self) -> tuple:
+        """Phase 2 of an overlapped pull (same return shape as
+        :meth:`pull_finish`); a connection that died mid-flight — a
+        mid-stream reset included — reconnects via the standard backoff
+        and re-pulls: a pull is an idempotent read, so the retry can
+        never double-apply anything."""
+        try:
+            return self.pull_finish()
+        except (ConnectionError, OSError):
+            self.reconnect()
+            self.pull_send()
+            return self.pull_finish()
+
     def commit_send(self, delta: Any, last_update: Optional[int] = None,
                     gap_s: Optional[float] = None) -> None:
         """Phase 1 of a commit: codec-encode and ship the delta;
@@ -465,6 +599,14 @@ class PSClient:
             msg["trace"] = trace
         if gap_s is not None:
             msg["gap_s"] = float(gap_s)
+        link_rtt = self.link.ewma
+        if link_rtt is not None:
+            # the link half of the straggler picture (ISSUE 15):
+            # harmless extra keys to old servers, like gap_s
+            msg["link_rtt_s"] = float(link_rtt)
+            if self._down_policy is not None and \
+                    self._down_policy.downshifts:
+                msg["link_downshifts"] = int(self._down_policy.downshifts)
         if last_update is not None:
             msg["last_update"] = int(last_update)
         self._t_commit = time.perf_counter()
@@ -476,7 +618,9 @@ class PSClient:
         injector dropped it; an eviction notice raises
         :class:`WorkerEvicted`."""
         resp = recv_msg(self._chan, registry=self.registry, count_as=_DOWN)
-        self._h_rtt.observe(time.perf_counter() - self._t_commit)
+        dt = time.perf_counter() - self._t_commit
+        self._h_rtt.observe(dt)
+        self.link.observe_commit(dt)
         # a server-side apply failure answers {"ok": False, "error"}
         # (it did NOT apply the delta) — that must surface as a
         # failure to the worker's retry policy, never as success

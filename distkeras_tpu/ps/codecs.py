@@ -342,9 +342,11 @@ class AdaptiveDownPolicy:
     """Per-link DOWN codec selection from measured pull RTTs (ISSUE 12).
 
     Lives on the CLIENT — the end that actually measures the link: each
-    pull's round-trip (which already folds in the server's encode time,
-    the transfer, and this end's decode) is attributed to the codec that
-    carried it.  The policy seeds an EWMA per candidate during a warmup
+    pull's VISIBLE wait (which folds in the server's encode time, the
+    un-overlapped transfer, and this end's decode — but never the
+    caller's compute between ``pull_begin`` and ``pull_join``, so
+    dispatch-ahead pulls compare codecs by what they still cost the
+    critical path) is attributed to the codec that carried it.  The policy seeds an EWMA per candidate during a warmup
     sweep, then serves the argmin — with **hysteresis**: a challenger
     must beat the incumbent by ``margin`` on ``patience`` consecutive
     evaluations before a switch, so RTT noise never flaps the link.
@@ -352,12 +354,27 @@ class AdaptiveDownPolicy:
     bounded :attr:`trail` (the recorded decision log obsview and tests
     read); a periodic re-probe keeps the losers' EWMAs honest as link
     conditions drift.
+
+    ISSUE 15 folds the reprobe schedule into the straggler detector's
+    **link-quality signal**: given a :class:`~..obs.stragglers.LinkQuality`
+    (the per-link pull/commit RTT EWMAs the client already measures), a
+    degraded link (1) **downshifts** the codec one step toward more
+    compression IMMEDIATELY — no hysteresis wait, because the remedy for
+    a link that just got slower is fewer bytes *now*, before the
+    worker's stretched window gap gets it flagged as a straggler — with
+    every downshift a recorded ``ps.link.downshifts`` event on the
+    trail, and (2) tightens the re-probe cadence (``reprobe_every // 4``)
+    while degraded, so the EWMAs re-learn the shifted link quickly.  The
+    normal hysteresis path still owns the recovery upshift once probes
+    show the cheaper codec winning again.
     """
 
+    #: candidate order is bytes-descending ("none" ships the most), so a
+    #: downshift is one step to the right — strictly fewer bytes
     def __init__(self, registry, candidates=("none", "bf16", "int8"),
                  margin: float = 0.2, patience: int = 3,
                  reprobe_every: int = 25, alpha: float = 0.3,
-                 warmup_samples: int = 2):
+                 warmup_samples: int = 2, link=None):
         for c in candidates:
             if c != "none":
                 validate_down_spec(c)
@@ -367,6 +384,12 @@ class AdaptiveDownPolicy:
         self.reprobe_every = int(reprobe_every)
         self.alpha = float(alpha)
         self.warmup_samples = int(warmup_samples)
+        #: per-link RTT EWMAs with a degradation edge (ISSUE 15); None
+        #: keeps the pre-link behavior exactly
+        self.link = link
+        #: cumulative link-degradation downshifts — shipped on the
+        #: commit RPC next to the link EWMA
+        self.downshifts = 0
         self.current = self.candidates[0]
         self._ewma: dict = {}
         self._samples: dict = {c: 0 for c in self.candidates}
@@ -377,7 +400,31 @@ class AdaptiveDownPolicy:
         #: bounded decision log: one entry per switch
         self.trail: collections.deque = collections.deque(maxlen=256)
         self._c_switches = registry.counter("ps.codec.switches")
+        self._c_downshifts = registry.counter("ps.link.downshifts")
         self._log = get_logger("ps.down")
+
+    def _downshift(self) -> Optional[str]:
+        """One step toward more compression on a degraded link, or None
+        when already at the smallest candidate."""
+        i = self.candidates.index(self.current)
+        if i + 1 >= len(self.candidates):
+            return None
+        nxt = self.candidates[i + 1]
+        self.trail.append({"pull": self._n, "from": self.current,
+                           "to": nxt, "kind": "downshift"})
+        self._log.warning(
+            "link degraded (RTT EWMA over %.1fx its best): downshifting "
+            "DOWN codec %s -> %s", self.link.degrade_factor, self.current,
+            nxt)
+        self.current = nxt
+        self.downshifts += 1
+        self._c_downshifts.inc()
+        self._streak_for, self._streak = None, 0
+        # the link's byte profile just changed: rebase the degradation
+        # baseline so the edge measures the NEW codec's link, and the
+        # downshift self-cools instead of cascading every pull
+        self.link.rebase()
+        return nxt
 
     def next_codec(self) -> str:
         """The codec the NEXT pull should request."""
@@ -385,7 +432,17 @@ class AdaptiveDownPolicy:
             if self._samples[c] < self.warmup_samples:
                 return c
         self._n += 1
-        if self.reprobe_every and self._n % self.reprobe_every == 0:
+        degraded = self.link is not None and self.link.degraded()
+        if degraded:
+            shifted = self._downshift()
+            if shifted is not None:
+                return shifted
+        reprobe = self.reprobe_every
+        if degraded and reprobe:
+            # a degraded link's EWMAs are stale by definition: re-probe
+            # the alternatives 4x as often until the edge clears
+            reprobe = max(2, reprobe // 4)
+        if reprobe and self._n % reprobe == 0:
             others = [c for c in self.candidates if c != self.current]
             if others:
                 self._probe_cursor = (self._probe_cursor + 1) % len(others)

@@ -588,6 +588,7 @@ def _run_thread_workers(trainer, ps, server, mode, center, xs, ys, num_epoch,
             comm_codec=getattr(trainer, "comm_codec", "none"),
             comm_down=getattr(trainer, "comm_down", "none"),
             shm=getattr(trainer, "ps_shm", False),
+            pull_overlap=getattr(trainer, "pull_overlap", False),
             profile_memory=trainer.profile.memory,
             generation=generation, **kw)
         if stream is not None:
@@ -683,6 +684,7 @@ def _run_process_workers(trainer, ps, server, mode, center, xs, ys,
             "comm_codec": getattr(trainer, "comm_codec", "none"),
             "comm_down": getattr(trainer, "comm_down", "none"),
             "ps_shm": bool(getattr(trainer, "ps_shm", False)),
+            "pull_overlap": bool(getattr(trainer, "pull_overlap", False)),
             "profile_memory": bool(trainer.profile.memory),
             "alpha": float(getattr(trainer, "alpha", 0.0)),
             "worker_id": k, "host": "127.0.0.1", "port": _endpoint(server),

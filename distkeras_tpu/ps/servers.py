@@ -36,7 +36,10 @@ from ..obs.spans import SpanTracer
 from ..parallel.sync import _inexact, tmap as _tree_map
 from ..utils import native
 from . import codecs
-from .networking import (REPLY_SENT, WIRE_VERSION, FrameServer, send_packed)
+from .networking import (MIN_STREAM_CHUNK_BYTES, REPLY_SENT,
+                         STREAM_CHUNK_BYTES, WIRE_VERSION, FrameServer,
+                         pack_stream, send_packed, send_stream,
+                         stream_enabled_env)
 from .state import DeltaDecoder, DownRefState, LivenessTable, PullCache
 
 Tree = Any
@@ -370,6 +373,18 @@ class SocketParameterServer(FrameServer):
     link switching codecs can never be served a stale pre-serialized
     payload.  Requests without ``down`` (v1 peers, ``comm_down="none"``)
     take the exact pre-ISSUE-12 raw path, bit-identical on the wire.
+
+    ISSUE 15 streamed pulls: a pull request carrying a ``stream`` map on
+    a stream-negotiated connection gets its reply as a ``DKW4`` chunk
+    stream — the same reply document (raw or DOWN-compressed), split
+    into plan-ordered leaf groups and cached as pre-serialized chunk
+    payloads under a composite ``(ver, "stream", chunk_bytes, ...)`` key
+    (single-flight per chunk shape), so a cold fleet pays one
+    serialization per chunk.  The client decodes chunk k while chunk
+    k+1 is on the wire and dispatches its window the moment the final
+    chunk lands.  Requests without ``stream`` (v1 peers,
+    stream-disabled clients, ``DKTPU_STREAM=0`` on either end) take the
+    exact monolithic path, bit-identical on the wire.
     """
 
     metric_prefix = "ps"
@@ -380,7 +395,8 @@ class SocketParameterServer(FrameServer):
                  max_wire_version: int = WIRE_VERSION,
                  tracer: Optional[SpanTracer] = None,
                  straggler_detector: Optional[StragglerDetector] = None,
-                 down_ref_every: int = 64):
+                 down_ref_every: int = 64,
+                 stream: Optional[bool] = None):
         #: front-end instruments live in the PS's registry so one snapshot
         #: covers update rules AND wire traffic
         super().__init__(ps.registry, host=host, port=port,
@@ -416,6 +432,13 @@ class SocketParameterServer(FrameServer):
         self._c_requests = ps.registry.counter("ps.commit_requests")
         self._c_dropped = ps.registry.counter("ps.commits_dropped")
         self._c_unchanged = ps.registry.counter("ps.pulls_unchanged")
+        #: streamed-pull serving (ISSUE 15): opt-out per server or via
+        #: ``DKTPU_STREAM=0``; counters pre-created so 0 is present in
+        #: every snapshot, streamed or not
+        self.stream = stream_enabled_env() if stream is None \
+            else bool(stream)
+        self._c_streams = ps.registry.counter("ps.pull.streams")
+        self._c_stream_chunks = ps.registry.counter("ps.pull.stream_chunks")
 
     def _remote_span(self, name: str, msg: dict):
         """Server-side span adopting the requester's trace context (the
@@ -470,17 +493,24 @@ class SocketParameterServer(FrameServer):
         reply = super().hello_reply(msg, ver)
         if ver >= 2 and isinstance(msg.get("down"), dict):
             reply["down"] = {"ok": True, "codecs": list(codecs.DOWN_CODECS)}
+        if ver >= 2 and self.stream and isinstance(msg.get("stream"), dict):
+            reply["stream"] = {"ok": True}
         return reply
 
-    def _down_payload(self, msg: dict, ver: int, center, updates: int,
-                      extra: dict):
-        """The pre-serialized reply for a DOWN-compressed pull, or None
-        when this request takes the raw path (no ``down`` map, v1 peer,
-        or the adaptive policy picked "none" for this pull)."""
+    def _pull_doc(self, msg: dict, ver: int, center, updates: int,
+                  extra: dict) -> tuple:
+        """``(shape_key, build)`` for one pull's reply document — the
+        payload-shape suffix of the cache key plus the builder the cache
+        calls on miss.  ``()`` + a raw center doc for the plain path; a
+        DOWN-compressed pull (ISSUE 12) gets the ``(spec, epoch,
+        resync)`` shape and the residual/resync builder.  ONE definition
+        so the monolithic and streamed reply paths (ISSUE 15) can never
+        disagree on the document they serialize."""
         req = msg.get("down") if ver >= 2 else None
         spec = req.get("codec") if isinstance(req, dict) else None
         if not spec or spec == "none":
-            return None
+            return (), lambda: {"center": center, "updates": updates,
+                                **extra}
         spec = str(spec)
         epoch, ref = self._down_ref.for_pull(center, updates)
         resync = req.get("ref_epoch") is None \
@@ -511,8 +541,35 @@ class SocketParameterServer(FrameServer):
         # besides the counter — codec, reference epoch, resync shape —
         # so a codec-state change without a counter bump can never be
         # served a stale pre-serialized payload
-        return self._pull_cache.payload((ver, spec, epoch, resync),
-                                        updates, build, owner=self.ps)
+        return (spec, epoch, resync), build
+
+    def _pull_payloads(self, msg: dict, ver: int, center, updates: int,
+                       extra: dict) -> tuple:
+        """``(parts_or_payload, streamed)`` for one fresh pull — the
+        streamed chunk list when this request negotiated + asked for
+        streaming (ISSUE 15), else the monolithic pre-serialized payload
+        (bit-identical to the pre-streaming wire)."""
+        shape, build = self._pull_doc(msg, ver, center, updates, extra)
+        req = msg.get("stream") if ver >= 2 and self.stream else None
+        if isinstance(req, dict):
+            cb = max(MIN_STREAM_CHUNK_BYTES,
+                     int(req.get("chunk_bytes") or STREAM_CHUNK_BYTES))
+
+            def build_parts() -> tuple:
+                doc = build()
+                down = doc.get("down") or {}
+                return (pack_stream(doc, cb, version=ver),
+                        doc.get("center", down.get("reference")))
+
+            parts = self._pull_cache.payload_parts(
+                (ver, "stream", cb, *shape), updates, build_parts,
+                owner=self.ps)
+            self._c_streams.inc()
+            self._c_stream_chunks.inc(len(parts) - 1)
+            return parts, True
+        key = (ver, *shape) if shape else ver
+        return self._pull_cache.payload(key, updates, build,
+                                        owner=self.ps), False
 
     def handle_request(self, action, msg: dict, ver: int,
                        conn: socket.socket):
@@ -538,16 +595,15 @@ class SocketParameterServer(FrameServer):
                 if have is not None and int(have) == updates:
                     self._c_unchanged.inc()
                     return {"unchanged": True, "updates": updates, **extra}
-                payload = self._down_payload(msg, ver, center, updates,
-                                             extra)
-                if payload is None:
-                    payload = self._pull_cache.payload(
-                        ver, updates,
-                        lambda: {"center": center, "updates": updates,
-                                 **extra},
-                        owner=self.ps)
-                send_packed(conn, payload, registry=self.ps.registry,
-                            count_as=f"{self.metric_prefix}.wire.bytes_down")
+                payload, streamed = self._pull_payloads(msg, ver, center,
+                                                        updates, extra)
+                down_counter = f"{self.metric_prefix}.wire.bytes_down"
+                if streamed:
+                    send_stream(conn, payload, registry=self.ps.registry,
+                                count_as=down_counter)
+                else:
+                    send_packed(conn, payload, registry=self.ps.registry,
+                                count_as=down_counter)
                 return REPLY_SENT
         if action == "commit":
             # every commit REQUEST counts before any outcome branches, so
@@ -559,6 +615,14 @@ class SocketParameterServer(FrameServer):
             if msg.get("gap_s") is not None:
                 self.stragglers.record(msg.get("worker_id"),
                                        msg.get("gap_s"))
+            if msg.get("link_rtt_s") is not None:
+                # per-link RTT EWMA shipped next to the heartbeat gap
+                # (ISSUE 15): the link-quality half of the straggler
+                # picture — a stretched gap whose link stretched equally
+                # is wire-degraded, not compute-stuck
+                self.stragglers.record_link(msg.get("worker_id"),
+                                            msg.get("link_rtt_s"),
+                                            msg.get("link_downshifts"))
             dropped = bool(self.fault_injector and
                            self.fault_injector("commit", msg))
             applied = True

@@ -98,7 +98,10 @@ class ShardedPSClient:
     another's), and likewise each connection owns its own DOWN reference
     epoch, adaptive policy, and shm rings (ISSUE 12) — a mixed fleet
     where only SOME shards can attach the rings simply runs those
-    connections on TCP, per-link."""
+    connections on TCP, per-link.  Streamed pulls (ISSUE 15) negotiate
+    per-connection the same way: a shard that refused (or predates) the
+    ``stream`` offer answers monolithically while its siblings stream,
+    and the assembled center is identical either way."""
 
     def __init__(self, addrs: Sequence[Tuple[str, int]], template: Tree,
                  worker_id: int = 0, registry: Optional[Registry] = None,
@@ -106,7 +109,9 @@ class ShardedPSClient:
                  tracer=None, generation: int = 0, plan_epoch: int = 0,
                  max_cut_rounds: int = 100, down=None,
                  shm: Optional[bool] = None,
-                 shm_mb: Optional[float] = None):
+                 shm_mb: Optional[float] = None,
+                 stream: Optional[bool] = None,
+                 stream_chunk_bytes: Optional[int] = None):
         addrs = [(h, int(p)) for h, p in addrs]
         if not addrs:
             raise ValueError("ShardedPSClient needs at least one shard")
@@ -130,7 +135,8 @@ class ShardedPSClient:
                     host, port, worker_id, registry=self.registry,
                     codec=codec, wire_version=wire_version, tracer=tracer,
                     generation=generation, down=down, shm=shm,
-                    shm_mb=shm_mb))
+                    shm_mb=shm_mb, stream=stream,
+                    stream_chunk_bytes=stream_chunk_bytes))
             self._verify_plan()
         except BaseException:
             self.close()
@@ -141,6 +147,9 @@ class ShardedPSClient:
         #: ``commit`` (staleness is a per-shard quantity)
         self._pull_counters = [0] * len(self.clients)
         self._warned_incomplete = False
+        #: True while an overlapped pull's round-1 requests are in
+        #: flight (ISSUE 15: ``pull_begin`` sent, ``pull_join`` pending)
+        self._begun = False
 
     # -- plan agreement -----------------------------------------------------
     def _verify_plan(self) -> None:
@@ -178,24 +187,26 @@ class ShardedPSClient:
             return contextlib.nullcontext()
         return self.tracer.span(name, worker=self.worker_id)
 
-    def _pull_round(self, pending, min_updates=None) -> dict:
+    def _pull_round(self, pending, min_updates=None,
+                    presend: bool = True) -> dict:
         """One pipelined pull round over the ``pending`` shard indices:
         all requests out, then all replies in.  A dead connection gets
         one reconnect per phase (a pull is an idempotent read).  On
         retry rounds ``min_updates`` carries the cut target's total
         commit count: the lagging shard WAITS for its in-flight applies
-        instead of shipping a slice the cut check would discard."""
-        sent = []
-        for i in pending:
-            c = self.clients[i]
-            try:
-                c.pull_send(min_updates)
-            except (ConnectionError, OSError):
-                c.reconnect()
-                c.pull_send(min_updates)
-            sent.append(i)
+        instead of shipping a slice the cut check would discard.
+        ``presend=False`` skips the send phase — an overlapped pull
+        (:meth:`pull_begin`) already fanned round 1's requests out."""
+        if presend:
+            for i in pending:
+                c = self.clients[i]
+                try:
+                    c.pull_send(min_updates)
+                except (ConnectionError, OSError):
+                    c.reconnect()
+                    c.pull_send(min_updates)
         out = {}
-        for i in sent:
+        for i in pending:
             c = self.clients[i]
             try:
                 out[i] = c.pull_finish()
@@ -212,16 +223,46 @@ class ShardedPSClient:
         with self._span("ps.shard.pull"):
             return self._pull_cut()
 
-    def _pull_cut(self) -> tuple:
+    # -- overlapped pulls (ISSUE 15) ----------------------------------------
+    def pull_begin(self, min_updates=None) -> None:
+        """Phase 1 of an overlapped consistent-cut pull: round 1's
+        requests go to every shard (pipelined, reconnect-once like any
+        idempotent read); the dispatch-ahead worker computes its window
+        while every shard's slice rides the wire, then
+        :meth:`pull_join` collects round 1 and runs the cut protocol."""
+        for c in self.clients:
+            try:
+                c.pull_send(min_updates)
+            except (ConnectionError, OSError):
+                c.reconnect()
+                c.pull_send(min_updates)
+        self._begun = True
+
+    def pull_join(self) -> tuple:
+        """Phase 2 of an overlapped pull: ``(center, total_updates,
+        None, None)`` — the same leading shape as
+        ``PSClient.pull_finish`` so the worker loop drives either client
+        identically."""
+        with self._span("ps.shard.pull"):
+            try:
+                center, total = self._pull_cut(first_sent=self._begun)
+            finally:
+                self._begun = False
+            return center, total, None, None
+
+    def _pull_cut(self, first_sent: bool = False) -> tuple:
         n = len(self.clients)
         results: List[Optional[tuple]] = [None] * n
         pending = list(range(n))
         min_updates = None
         prev_vvs = None
         stable = 0
-        for _ in range(self.max_cut_rounds):
+        for rnd in range(self.max_cut_rounds):
             self._c_rounds.inc()
-            for i, r in self._pull_round(pending, min_updates).items():
+            replies = self._pull_round(
+                pending, min_updates,
+                presend=not (first_sent and rnd == 0))
+            for i, r in replies.items():
                 results[i] = r
             for i, (_, _, _, epoch) in enumerate(results):
                 if epoch is not None and epoch != self.plan.epoch:

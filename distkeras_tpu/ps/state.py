@@ -80,6 +80,10 @@ class PullCache:
     already-cached center; the never-regress rule keeps a racing handler
     from replacing a NEWER cached center with an older snapshot (which
     would hand a committed worker a pre-commit center on its next pull).
+    ISSUE 15: a STREAMED pull's chunk payloads cache the same way —
+    :meth:`payload_parts` stores the whole prologue+chunks list under
+    one composite key (chunk bound included), single-flight across the
+    shape's chunks, so a cold fleet pays one serialization per chunk.
     """
 
     def __init__(self, registry, prefix: str = "ps"):
@@ -102,6 +106,33 @@ class PullCache:
         serialization per payload shape, not one per puller.  Builds for
         DIFFERENT keys still overlap."""
         ver = key[0] if isinstance(key, tuple) else key
+
+        def build():
+            doc = doc_builder()
+            down = doc.get("down") or {}
+            return (pack_msg(doc, version=ver),
+                    doc.get("center", down.get("reference")))
+
+        return self._cached(key, updates, build, owner)
+
+    def payload_parts(self, key, updates: int,
+                      parts_builder: Callable[[], tuple],
+                      owner: Any = None):
+        """Like :meth:`payload` but for a STREAMED pull reply (ISSUE 15):
+        the cached value is the ordered LIST of packed payloads —
+        prologue + one per chunk (``networking.pack_stream``'s output) —
+        under ONE composite key, so the single-flight claim covers every
+        chunk of the shape at once: a cold fleet pays one serialization
+        per chunk, never one per puller per chunk.  ``parts_builder``
+        returns ``(packed_parts, publish_tree)`` — the chunk payloads
+        alias the center's buffers, so the publish contract is the same
+        as :meth:`payload`'s."""
+        return self._cached(key, updates, parts_builder, owner)
+
+    def _cached(self, key, updates: int, build: Callable[[], tuple],
+                owner: Any):
+        """The single-flight / never-regress cache body both payload
+        shapes share; ``build()`` returns ``(value, publish_tree)``."""
         my_evt = None
         while True:
             with self._lock:
@@ -126,8 +157,7 @@ class PullCache:
             # killed uncleanly); the loop re-reads either way
             waiter.wait(timeout=30.0)
         try:
-            doc = doc_builder()
-            payload = pack_msg(doc, version=ver)
+            payload, publish_tree = build()
         except BaseException:
             if my_evt is not None:
                 with self._lock:
@@ -142,8 +172,7 @@ class PullCache:
             # this is the publish instant the racecheck contract guards.
             # DOWN docs publish their reference tree instead — the one
             # center-owned buffer set a resync payload shares.
-            down = doc.get("down") or {}
-            hook(owner, doc.get("center", down.get("reference")))
+            hook(owner, publish_tree)
         with self._lock:
             cur = self._cache.get(key)
             if cur is None or updates >= cur[0] or cur[1] is my_evt:

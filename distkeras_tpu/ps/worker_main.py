@@ -17,6 +17,9 @@ The spec is a msgpack tree (``utils.serde``):
      "topk<frac>"/"adaptive", default "none"; ISSUE 12),
      "ps_shm": bool (offer the same-host shared-memory transport in the
      hello — co-located workers skip TCP; default False),
+     "pull_overlap": bool (dispatch-ahead pulls — issue window k+1's
+     pull while window k's device step runs, hiding the center transfer
+     behind compute; default False, ISSUE 15),
      "alpha": float, "worker_id": int, "host": str, "port": int,
      "num_epoch": int, "seed": int, "data_npz": path, "out_npz": path,
      "metrics_jsonl": path (optional — this process's own telemetry
@@ -98,6 +101,7 @@ def run_spec(spec_path: str) -> None:
         comm_codec=spec.get("comm_codec", "none"), metrics=metrics,
         comm_down=spec.get("comm_down", "none"),
         shm=bool(spec.get("ps_shm", False)),
+        pull_overlap=bool(spec.get("pull_overlap", False)),
         profile_memory=bool(spec.get("profile_memory", True)),
         generation=int(spec.get("gen", 0)), **kw)
     if "stream" in spec:

@@ -57,7 +57,7 @@ class AsyncWorker(threading.Thread):
                  device=None, start_window: int = 0, metrics=None,
                  comm_codec: str = "none", profile_memory: bool = True,
                  generation: int = 0, comm_down: str = "none",
-                 shm: bool = False):
+                 shm: bool = False, pull_overlap: bool = False):
         super().__init__(name=f"worker-{worker_id}", daemon=True)
         self.worker_id = worker_id
         #: commit generation this incarnation runs under (ISSUE 9): the
@@ -85,6 +85,25 @@ class AsyncWorker(threading.Thread):
         #: first pull is a full resync by construction
         self.comm_down = comm_down
         self.shm = bool(shm)
+        #: dispatch-ahead pulls (ISSUE 15): issue window k+1's pull right
+        #: after window k's device step is DISPATCHED, so the center
+        #: transfer rides the wire while the device computes — the pull
+        #: all but leaves the window critical path (recorded per pull as
+        #: ``ps.pull.hidden_seconds`` / ``ps.pull.overlap_fraction``).
+        #: The worker then trains window k+1 from a center pulled before
+        #: its own commit k landed: one extra window of self-staleness,
+        #: exactly the regime the async update rules already absorb
+        #: (DynSGD's staleness math sees it as staleness 1).  Pull-first
+        #: workers only; the elastic family computes before it pulls, so
+        #: there is nothing to hide the transfer behind.
+        self.pull_overlap = bool(pull_overlap)
+        #: (center, seen_updates) collected by the previous window's
+        #: overlapped pull — the next window dispatches from it the
+        #: moment the final chunk lands
+        self._next_center = None
+        #: set per window by ``_train`` so the LAST window skips issuing
+        #: a dispatch-ahead pull nothing will consume
+        self._is_last_window = False
         #: optional shared JSONL sink (``MetricsLogger`` — thread-safe):
         #: one ``heartbeat`` record per committed window, so a stalled or
         #: straggling worker is visible IN-RUN, not post-mortem (ISSUE 2)
@@ -178,7 +197,22 @@ class AsyncWorker(threading.Thread):
         self._last_commit_mono = now
         return self._gap_s
 
+    @staticmethod
+    def _link_ewma(client) -> Optional[float]:
+        """The client's link RTT EWMA (ISSUE 15) — representative across
+        a sharded client's connections (the slowest link gates the
+        fan-out, so take the max)."""
+        link = getattr(client, "link", None)
+        if link is not None:
+            return link.ewma
+        subs = getattr(client, "clients", None)
+        if subs:
+            ewmas = [c.link.ewma for c in subs if c.link.ewma is not None]
+            return max(ewmas) if ewmas else None
+        return None
+
     def _train(self, client: PSClient):
+        self._client = client
         stream = getattr(self, "_stream_factory", None)
         n_windows = self._stream_windows if stream is not None \
             else int(self.xs.shape[0])
@@ -189,6 +223,7 @@ class AsyncWorker(threading.Thread):
             else:
                 for gw in range(self.start_window, total):
                     wi = gw % n_windows  # window within the epoch
+                    self._is_last_window = gw == total - 1
                     wx = self._put(self.xs[wi])
                     wy = self._put(self.ys[wi])
                     losses = self._window(client, wx, wy)
@@ -223,6 +258,7 @@ class AsyncWorker(threading.Thread):
                     next(it)
                 for _ in range(skip, n_windows):
                     wx, wy = next(it)
+                    self._is_last_window = gw == total - 1
                     losses = self._window(client, self._put(wx),
                                           self._put(wy))
                     self.window_losses.append((gw, np.asarray(losses)))
@@ -246,6 +282,11 @@ class AsyncWorker(threading.Thread):
         extra = {}
         if self.profile_memory:
             extra["live_bytes"] = obs_profile.observe_memory()["live_bytes"]
+        link = self._link_ewma(getattr(self, "_client", None))
+        if link is not None:
+            # the link half of the health record (ISSUE 15): obsview's
+            # offline replay renders gap and link side by side
+            extra["link_rtt_s"] = float(link)
         self.metrics.log("heartbeat", worker_id=self.worker_id, window=gw,
                          epoch=gw // n_windows, gap_s=self._gap_s,
                          mean_loss=float(np.mean(losses)), **extra)
@@ -266,34 +307,67 @@ class AsyncWorker(threading.Thread):
         raise NotImplementedError
 
 
-class PullCommitWorker(AsyncWorker):
+class _PullFirstWorker(AsyncWorker):
+    """Shared loop shape of the pull-first family (DOWNPOUR / ADAG /
+    DynSGD): pull center -> train a window from it -> commit the delta.
+
+    With ``pull_overlap`` (ISSUE 15) the loop becomes dispatch-ahead:
+
+    1. dispatch window k's device step (JAX async dispatch — returns
+       before the device finishes);
+    2. ``pull_begin()`` — window k+1's center transfer starts NOW;
+    3. block on window k's outputs (the device time is what hides the
+       transfer) and build the delta;
+    4. ``pull_join()`` — by now the final chunk has usually landed, so
+       window k+1 can dispatch the moment this returns;
+    5. commit window k.
+
+    The wire order per connection stays the strict split-phase contract
+    (pull request, pull reply, commit request, commit reply), so there
+    is no head-of-line deadlock and no reply mismatch; the cost is one
+    window of self-staleness — window k+1's center predates commit k —
+    which is exactly the regime the async update rules absorb."""
+
+    def _commit_kw(self, seen_updates) -> dict:
+        """Extra commit kwargs derived from the pull (DynSGD's
+        ``last_update``)."""
+        return {}
+
+    def _window(self, client, wx, wy):
+        if self._next_center is not None:
+            center, seen = self._next_center
+            self._next_center = None
+        else:
+            pulled = client.pull()
+            center, seen = pulled[0], pulled[1]
+        self.variables = self._put(_merge_pull(_host(self.variables), center))
+        losses = self._run_window(wx, wy)
+        overlap = self.pull_overlap and not self._is_last_window
+        if overlap:
+            # window k+1's pull rides the wire while the device runs
+            client.pull_begin()
+        after = _host(self.variables)
+        delta = _tmap(lambda a, c: a - np.asarray(c), after, center)
+        if overlap:
+            nxt = client.pull_join()
+            self._next_center = (nxt[0], nxt[1])
+        client.commit(delta, **self._commit_kw(seen),
+                      gap_s=self._commit_gap())
+        return losses
+
+
+class PullCommitWorker(_PullFirstWorker):
     """DOWNPOUR / ADAG: local model is replaced by the pulled center each
     window; the commit is the accumulated local update Δ = θ_after −
     θ_pulled (the server's rule decides scaling)."""
 
-    def _window(self, client, wx, wy):
-        center, _ = client.pull()
-        self.variables = self._put(_merge_pull(_host(self.variables), center))
-        losses = self._run_window(wx, wy)
-        after = _host(self.variables)
-        delta = _tmap(lambda a, c: a - np.asarray(c), after, center)
-        client.commit(delta, gap_s=self._commit_gap())
-        return losses
 
-
-class StalenessWorker(AsyncWorker):
+class StalenessWorker(_PullFirstWorker):
     """DynSGD: like PullCommitWorker but the commit reports the server
     update counter observed at pull time (staleness bookkeeping)."""
 
-    def _window(self, client, wx, wy):
-        center, seen_updates = client.pull()
-        self.variables = self._put(_merge_pull(_host(self.variables), center))
-        losses = self._run_window(wx, wy)
-        after = _host(self.variables)
-        delta = _tmap(lambda a, c: a - np.asarray(c), after, center)
-        client.commit(delta, last_update=seen_updates,
-                      gap_s=self._commit_gap())
-        return losses
+    def _commit_kw(self, seen_updates):
+        return {"last_update": seen_updates}
 
 
 class ElasticWorker(AsyncWorker):

@@ -485,6 +485,7 @@ class DistributedTrainer(Trainer):
                  comm_codec: str = "none",
                  comm_down: str = "none",
                  ps_shm: bool = False,
+                 pull_overlap: bool = False,
                  ps_shards: int = 1,
                  heartbeat_hard_s: float = 30.0,
                  startup_grace_s: float = 300.0, **kw):
@@ -550,6 +551,15 @@ class DistributedTrainer(Trainer):
         #: host) skip the kernel socket path, cross-host peers are
         #: refused at the capability probe and stay on TCP untouched
         self.ps_shm = bool(ps_shm)
+        #: async-mode dispatch-ahead pulls (ISSUE 15): each pull-first
+        #: worker issues window k+1's pull right after window k's device
+        #: step is dispatched, hiding the center transfer behind compute
+        #: (``ps.pull.hidden_seconds`` / ``ps.pull.overlap_fraction``)
+        #: at the cost of one window of self-staleness — the regime the
+        #: async update rules already absorb.  Streamed pull replies
+        #: themselves (the ``DKW4`` chunk wire) are negotiated per
+        #: connection and on by default; ``DKTPU_STREAM=0`` opts out.
+        self.pull_overlap = bool(pull_overlap)
 
     # -- fleet elasticity (ISSUE 9) -----------------------------------------
     def add_worker(self, worker_id=None) -> int:

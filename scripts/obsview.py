@@ -262,6 +262,67 @@ def _codec_lines(stats: dict) -> list:
     return lines
 
 
+def _stream_lines(stats: dict) -> list:
+    """Pull streaming (ISSUE 15): streamed-pull and chunk counters plus —
+    on client-side registries — the overlap accounting (how much of each
+    fresh pull's wall time hid behind compute) and chunk-size quantiles.
+    Empty on pre-streaming snapshots."""
+    streams = stats.get("ps.pull.streams", {}).get("value", 0)
+    hidden = stats.get("ps.pull.hidden_seconds")
+    if not streams and not (hidden and hidden.get("count")):
+        return []
+    lines = ["== Pull streaming =="]
+    chunks = stats.get("ps.pull.stream_chunks", {}).get("value", 0)
+    line = f"streamed pulls: {streams:,.0f}   chunks: {chunks:,.0f}"
+    if streams:
+        line += f"   chunks/pull: {chunks / streams:.1f}"
+    frac = stats.get("ps.pull.overlap_fraction", {}).get("value")
+    if frac is not None:
+        line += f"   overlap: {100 * _num(frac, 0.0):.0f}% hidden"
+    lines.append(line)
+    h = stats.get("ps.pull.chunk_bytes")
+    if h and h.get("count"):
+        lines.append(
+            f"{'chunk bytes':>12}: n={h['count']} "
+            f"p50 {snapshot_quantile(h, 0.5):,.0f}  "
+            f"p99 {snapshot_quantile(h, 0.99):,.0f}")
+    if hidden and hidden.get("count"):
+        lines.append(
+            f"{'hidden':>12}: n={hidden['count']} mean "
+            f"{_fmt_seconds(hidden['sum'] / hidden['count'])}  p99 "
+            f"{_fmt_seconds(snapshot_quantile(hidden, 0.99))} per pull")
+    downshifts = stats.get("ps.link.downshifts", {}).get("value")
+    if downshifts:
+        lines.append(f"{'downshifts':>12}: {downshifts:,.0f} "
+                     "(link-degradation codec downshifts)")
+    return lines
+
+
+def _link_lines(snap: dict) -> list:
+    """Link-quality table (ISSUE 15): per-worker link RTT EWMAs (shipped
+    on the commit RPC) next to the codec-downshift trail — the numbers
+    that tell a wire-degraded worker from a compute-stuck one.  Empty
+    when no worker reported a link RTT."""
+    link = (snap or {}).get("link_rtt_s") or {}
+    if not link:
+        return []
+
+    def _wkey(w):
+        try:
+            return (0, int(w))
+        except (TypeError, ValueError):
+            return (1, str(w))
+
+    downs = snap.get("link_downshifts") or {}
+    lines = ["== Link quality ==",
+             f"{'worker':>6}  {'link RTT EWMA':>14}  downshifts"]
+    for w in sorted(link, key=_wkey):
+        lines.append(f"{w:>6}  "
+                     f"{_fmt_seconds(_num(link[w], 0.0)):>14}  "
+                     f"{_num(downs.get(w), 0):>10,.0f}")
+    return lines
+
+
 def _timeline_lines(spans: list) -> list:
     """Per-worker cross-process timeline (ISSUE 5): worker ``ps.commit``
     spans matched to the server ``ps.apply`` spans that adopted their
@@ -460,11 +521,15 @@ def summarize(records: list) -> str:
                     f"{_fmt_seconds(snapshot_quantile(h, 0.99))}")
         sections.append(lines)
         sections.append(_codec_lines(stats))
+        sections.append(_stream_lines(stats))
     if heartbeats:
         # replay the recorded gaps through the same detector the live PS
-        # runs — post-mortem straggler analysis (ISSUE 5)
-        sections.append(_straggler_lines(
-            detect_from_heartbeats(records), "replayed from heartbeats"))
+        # runs — post-mortem straggler analysis (ISSUE 5); the replayed
+        # snapshot also carries the heartbeat-borne link RTTs (ISSUE 15)
+        replayed = detect_from_heartbeats(records)
+        sections.append(_straggler_lines(replayed,
+                                         "replayed from heartbeats"))
+        sections.append(_link_lines(replayed))
     if spans:
         sections.append(_timeline_lines(spans))
         sections.append(_top_spans(spans))
@@ -513,6 +578,7 @@ def summarize_snapshot(doc: dict) -> str:
     for name, snap in sorted(named.items()):
         sections.append([f"== {name} registry =="] + _instrument_lines(snap))
         sections.append(_codec_lines(snap))
+        sections.append(_stream_lines(snap))
     return "\n".join("\n".join(s) for s in sections if s)
 
 
@@ -528,6 +594,10 @@ def summarize_stats(reply: dict) -> str:
     if codec:
         lines.append("")
         lines.extend(codec)
+    stream = _stream_lines(stats)
+    if stream:
+        lines.append("")
+        lines.extend(stream)
     fleet = _fleet_lines(reply.get("fleet") or {}, stats)
     if fleet:
         lines.append("")
@@ -536,6 +606,10 @@ def summarize_stats(reply: dict) -> str:
     if stragglers:
         lines.append("")
         lines.extend(stragglers)
+    link = _link_lines(reply.get("stragglers") or {})
+    if link:
+        lines.append("")
+        lines.extend(link)
     if "ps.staleness" in stats:
         lines.append("")
         lines.extend(_staleness_lines(stats["ps.staleness"]))

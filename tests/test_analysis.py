@@ -555,6 +555,66 @@ def test_shm_lifecycle_ps_wire_stack_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# wire-seam
+# ---------------------------------------------------------------------------
+
+def test_wire_seam_flags_raw_socket_calls():
+    found = lint("""
+        import socket
+
+        def poll(host, port):
+            s = socket.create_connection((host, port))
+            s.sendall(b'stats')
+            return s.recv(4096)
+        """, rule="wire-seam")
+    assert len(found) == 2
+    assert all("networking" in f.message for f in found)
+    assert {"sendall", "recv"} == {f.message.split(".")[1].split("(")[0]
+                                   for f in found}
+
+
+def test_wire_seam_clean_through_the_seam():
+    found = lint("""
+        from distkeras_tpu.ps.networking import recv_msg, send_msg
+
+        def poll(sock):
+            send_msg(sock, {"action": "stats"})
+            return recv_msg(sock)
+        """, rule="wire-seam")
+    assert found == []
+
+
+def test_wire_seam_exempts_networking_itself_and_honors_pragma():
+    from distkeras_tpu.analysis import analyze_source
+    from distkeras_tpu.analysis.rules import RULES_BY_ID as rules
+    src = textwrap.dedent("""
+        def recv_exact(sock, n):
+            return sock.recv(n)
+        """)
+    # the seam file is the one legitimate caller
+    report = analyze_source(src, rel="distkeras_tpu/ps/networking.py",
+                            rules=[rules["wire-seam"]])
+    assert report.findings == []
+    # a non-socket receiver disables with the standard pragma
+    found = lint("""
+        def drain(pipe):
+            return pipe.recv()  # dklint: disable=wire-seam
+        """, rule="wire-seam")
+    assert found == []
+
+
+def test_wire_seam_repo_wire_stack_is_clean():
+    """ISSUE 15 gate: the PS/serve stacks route every wire byte through
+    ps/networking.py — no raw socket call bypasses the zero-copy /
+    fault-hook / byte-counter seam anywhere in the package."""
+    from distkeras_tpu.analysis import run_paths
+    from distkeras_tpu.analysis.rules import RULES_BY_ID as rules
+    report = run_paths([os.path.join(_ROOT, "distkeras_tpu")],
+                       rules=[rules["wire-seam"]])
+    assert report.findings == [], [f.location() for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
 # suppression: inline pragma + baseline round-trip
 # ---------------------------------------------------------------------------
 
