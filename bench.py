@@ -51,7 +51,10 @@ ISSUE 14 adds the **router phase** (``--engines N``): the
 ``ServeRouter`` fleet scaling sweep — aggregate tokens/sec + client
 p99 e2e vs fleet size over a shared-prefix workload with
 prefix-affinity routing, one merged fleet snapshot per point
-(``router_n<n>``), same drift gate.
+(``router_n<n>``), same drift gate.  ISSUE 16 adds the **KV-fabric
+phase** (same ``--engines``): forced overflow on the fleet with
+hot-prefix replication and planned-drain migration, certifying the
+warm-vs-cold spill ttft split (snapshot part ``fabric``).
 
 All benches self-check against the committed baseline snapshot named in
 ``OBS_BASELINE.json`` (ISSUE 5): the fresh run's registry snapshot is
@@ -334,6 +337,23 @@ SERVE_ROUTER_PHASE = dict(engines=3, groups=12, per_group=5,
                           vocab=256, dim=256, heads=4, blocks=2,
                           seq_len=128)
 
+#: Committed config for the KV-fabric phase (ISSUE 16): forced overflow
+#: on an N-engine fleet, warm-vs-cold spill TTFT split.  Every request
+#: is SERIALIZED and each spill is forced by pinning the affine owner
+#: at its in-flight bound, so routing, the prefix counters, and the
+#: replication/migration tallies are all deterministic under the drift
+#: gate's exact ``serve.prefix.*`` rule.  Sized like the ISSUE 11
+#: prefix phase: a long shared prefix whose cold prefill (the O(T²)
+#: attention term in the 256-token bucket) DOMINATES ttft, against a
+#: short-suffix warm join replayed in the 8-token bucket — the speedup
+#: the phase certifies is prefill avoided by moving KV across engines,
+#: not scheduler noise.
+SERVE_FABRIC_PHASE = dict(engines=3, groups=3, rounds=3, shared=504,
+                          tail=6, max_new=4, suffix_bucket=8,
+                          prefill_bucket=512, block=8, slots=2,
+                          queue=16, cache_mb=16.0, vocab=128, dim=128,
+                          heads=4, blocks=2, seq_len=544)
+
 
 def _serve_prefix_phase(phase: dict):
     """The warm-vs-cold ttft probe: serialized requests sharing a long
@@ -504,10 +524,17 @@ def _serve_router_phase(phase: dict):
                 eng = DecodeEngine(model, variables, cfg,
                                    registry=Registry()).warmup()
                 servers.append(ServeServer(eng).start())
+            # fabric OFF: this phase measures front-door ROUTING
+            # scaling, and its exact serve.prefix.* drift contract
+            # needs the storm's warm/miss split deterministic — the
+            # fabric's async spill transfers would add scheduling-
+            # dependent cold prefills.  The fabric phase below is the
+            # fabric's own (serialized, deterministic) proof.
             router = ServeRouter(
                 [("127.0.0.1", s.port) for s in servers],
                 config=RouterConfig(affinity_block=block,
-                                    stats_interval_s=0.2)).start()
+                                    stats_interval_s=0.2,
+                                    kv_fabric=False)).start()
             with ServeClient("127.0.0.1", router.port) as client:
                 for g in range(groups):
                     reply = client.generate(
@@ -591,12 +618,161 @@ def _serve_router_phase(phase: dict):
     return fields, parts
 
 
+def _serve_fabric_phase(phase: dict):
+    """The ISSUE 16 KV-fabric probe: N prefix-cached engines behind one
+    ``ServeRouter`` with the fabric on, every overflow FORCED (the
+    affine owner pinned at its in-flight bound) and every request
+    serialized so the run is deterministic end to end.
+
+    Pass 1 registers one hot prefix per group and warms its owner.
+    Pass 2 overflows each group once: the spill lands COLD on a
+    least-loaded survivor and seeds a fabric replication; the phase
+    then waits for every transfer to land.  Passes 3..rounds overflow
+    again: the router's secondary-owner hit routes each spill WARM onto
+    the replica.  Finally one owner takes a PLANNED drain — its hot KV
+    migrates to survivors, a follow-up request of its group must still
+    land warm — and the merged fleet snapshot (part ``"fabric"``) plus
+    the row fields certify the split: replicated-spill ttft p50 beats
+    cold-spill p50, transfers moved real bytes, ZERO stale refusals."""
+    import threading
+
+    from distkeras_tpu.serve import (DecodeEngine, RouterConfig,
+                                     ServeClient, ServeConfig,
+                                     ServeRouter, ServeServer)
+    from distkeras_tpu.obs import Registry
+
+    model = zoo.gpt_lm(vocab_size=phase["vocab"], dim=phase["dim"],
+                       num_heads=phase["heads"],
+                       num_blocks=phase["blocks"],
+                       seq_len=phase["seq_len"])
+    variables = model.init(0)
+    rng = np.random.default_rng(17)
+    engines, groups = int(phase["engines"]), int(phase["groups"])
+    rounds, block = int(phase["rounds"]), int(phase["block"])
+    max_new = int(phase["max_new"])
+    gshared = [rng.integers(0, phase["vocab"],
+                            size=(phase["shared"],)).astype(np.int32)
+               for _ in range(groups)]
+
+    def prompt(g):
+        tail = rng.integers(0, phase["vocab"],
+                            size=(phase["tail"],)).astype(np.int32)
+        return np.concatenate([gshared[g], tail])
+
+    servers, router = [], None
+    warm_ts, cold_ts = [], []
+    try:
+        for _ in range(engines):
+            cfg = ServeConfig(
+                slots=phase["slots"], max_queue=phase["queue"],
+                max_new_tokens=max_new,
+                prefill_buckets=(phase["suffix_bucket"],
+                                 phase["prefill_bucket"]),
+                prefix_cache=True, prefix_cache_mb=phase["cache_mb"],
+                prefix_block=block)
+            servers.append(ServeServer(DecodeEngine(
+                model, variables, cfg,
+                registry=Registry()).warmup()).start())
+        router = ServeRouter(
+            [("127.0.0.1", s.port) for s in servers],
+            config=RouterConfig(affinity_block=block,
+                                max_inflight=phase["slots"],
+                                stats_interval_s=30.0)).start()
+        fabric = router._kv_fabric
+
+        def spill(client, g):
+            """One forced overflow of group g: pin the affine owner at
+            the in-flight bound for exactly this request."""
+            owner = next(b for b in router.backends
+                         if b.addr == owners[g])
+            with router._lock:
+                owner.inflight = int(phase["slots"])
+            try:
+                reply = client.generate(prompt(g), max_new)
+            finally:
+                with router._lock:
+                    owner.inflight = 0
+            if not reply.get("ok"):
+                raise RuntimeError(f"fabric spill failed: {reply}")
+            return reply
+
+        with ServeClient("127.0.0.1", router.port) as client:
+            owners = []
+            for g in range(groups):  # pass 1: register + warm owners
+                reply = client.generate(prompt(g), max_new)
+                if not reply.get("ok"):
+                    raise RuntimeError(f"fabric warm pass: {reply}")
+                owners.append(reply["engine"])
+            for g in range(groups):  # pass 2: forced COLD spills
+                reply = spill(client, g)
+                if reply.get("warm") is not False:
+                    raise RuntimeError(
+                        f"first overflow of group {g} must cold-"
+                        f"prefill, got warm={reply.get('warm')!r}")
+                cold_ts.append(float(reply["ttft_s"]))
+            repl = router.registry.counter("serve.router.kv_replications")
+            deadline = time.monotonic() + 60.0
+            while (repl.value < groups or fabric._jobs
+                   or fabric._inflight):
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"fabric replication stalled: "
+                        f"{repl.value}/{groups} landed")
+                time.sleep(0.02)
+            for _ in range(1, rounds):  # passes 3..: WARM spills
+                for g in range(groups):
+                    reply = spill(client, g)
+                    if reply.get("warm") is not True:
+                        raise RuntimeError(
+                            f"replicated overflow of group {g} must "
+                            f"land warm, got warm={reply.get('warm')!r}")
+                    warm_ts.append(float(reply["ttft_s"]))
+            # planned drain: group 0's owner leaves, its KV migrates
+            dr = client.drain(engine=owners[0])
+            if not dr.get("ok") or not dr.get("drained"):
+                raise RuntimeError(f"planned drain failed: {dr}")
+            reply = client.generate(prompt(0), max_new)
+            if not reply.get("ok") or reply.get("warm") is not True:
+                raise RuntimeError(
+                    f"post-drain request must land warm on the "
+                    f"migration recipient, got {reply}")
+            st = client.stats()
+    finally:
+        if router is not None:
+            router.stop()
+        for s in servers:
+            s.stop()
+    merged = st["stats"]
+
+    def _v(name):
+        return merged.get(name, {}).get("value", 0)
+
+    warm_p50 = float(np.median(warm_ts))
+    cold_p50 = float(np.median(cold_ts))
+    fields = {
+        "fabric_engines": engines,
+        "fabric_ttft_spill_cold_ms_p50": round(cold_p50 * 1e3, 3),
+        "fabric_ttft_spill_warm_ms_p50": round(warm_p50 * 1e3, 3),
+        "fabric_spill_speedup": round(cold_p50 / warm_p50, 2)
+        if warm_p50 > 0 else None,
+        "fabric_kv_replications": int(_v("serve.router.kv_replications")),
+        "fabric_kv_migrations": int(_v("serve.router.kv_migrations")),
+        "fabric_kv_push_bytes": int(_v("serve.router.kv_push_bytes")),
+        "fabric_kv_refused_stale": int(
+            _v("serve.router.kv_refused_stale")),
+        "fabric_secondary_hits": int(
+            _v("serve.router.affinity_secondary_hits")),
+    }
+    return fields, merged
+
+
 def bench_serve(requests: int = 32, concurrency: int = 4,
                 prompt_len: int = 12, max_new: int = 16, slots: int = 4,
                 queue: int = 8, out_dir: str = ROOT, wire_version=None,
                 vocab: int = 64, dim: int = 32, heads: int = 2,
                 blocks: int = 1, seq_len: int = 64, prefix_phase=None,
-                spec_phase=None, router_phase=None) -> dict:
+                spec_phase=None, router_phase=None,
+                fabric_phase=None) -> dict:
     """Decode-service load bench (ISSUE 7 acceptance): a localhost
     ``ServeServer`` over a small ``gpt_lm`` and ``concurrency``
     closed-loop client threads driving ``requests`` generations through
@@ -633,9 +809,18 @@ def bench_serve(requests: int = 32, concurrency: int = 4,
     ``router_affinity_hit_rate``; one merged fleet snapshot part
     ``router_n<n>`` per point.
 
-    Both phases' registry snapshots ride in the SAME drift-gated
-    ``BENCH_SERVE_OBS.json``, so a future hit-rate or accept-rate
-    regression fails the gate like any perf regression."""
+    ISSUE 16 adds the **KV-fabric phase** (``SERVE_FABRIC_PHASE``
+    overrides, sharing ``--engines`` with the router phase): forced
+    overflow on an N-engine fleet — first overflow cold-prefills and
+    seeds a fabric replication, later overflows land warm on the
+    replica, one owner takes a planned drain with KV migration —
+    certifying ``fabric_spill_speedup`` (cold-spill vs replicated-spill
+    ttft p50), the transfer tallies, and ZERO stale refusals; merged
+    fleet snapshot part ``"fabric"``.
+
+    All phases' registry snapshots ride in the SAME drift-gated
+    ``BENCH_SERVE_OBS.json``, so a future hit-rate, accept-rate, or
+    spill-warmth regression fails the gate like any perf regression."""
     from distkeras_tpu.models import zoo
     from distkeras_tpu.obs import Registry, snapshot_quantile
     from distkeras_tpu.serve import (DecodeEngine, ServeClient,
@@ -729,6 +914,11 @@ def bench_serve(requests: int = 32, concurrency: int = 4,
         "rejected": sum(rejected),
         "jit_retraces": snap["jit.retraces"]["value"],
         "wire_version": min(negotiated),
+        # the fleet scaling curve is only meaningful when the recording
+        # host had cores to give each engine — committed-artifact
+        # contracts gate on this instead of asserting scale-up a
+        # single-core container cannot express
+        "host_cpus": os.cpu_count(),
     }
 
     # -- accelerator phases (ISSUE 11): row fields are ALWAYS present
@@ -739,12 +929,19 @@ def bench_serve(requests: int = 32, concurrency: int = 4,
         else {**SERVE_SPEC_PHASE, **(spec_phase or {})}
     router_cfg = None if router_phase is False \
         else {**SERVE_ROUTER_PHASE, **(router_phase or {})}
+    fabric_cfg = None if fabric_phase is False \
+        else {**SERVE_FABRIC_PHASE, **(fabric_phase or {})}
     row.update(dict.fromkeys(
         ("ttft_warm_ms_p50", "ttft_cold_ms_p50", "warm_speedup",
          "prefix_hit_rate", "spec_k", "tokens_per_sec_base",
          "tokens_per_sec_spec", "spec_uplift", "spec_accept_rate",
          "spec_parity", "router_engines", "router_scaling",
-         "router_speedup", "router_affinity_hit_rate")))
+         "router_speedup", "router_affinity_hit_rate",
+         "fabric_engines", "fabric_ttft_spill_cold_ms_p50",
+         "fabric_ttft_spill_warm_ms_p50", "fabric_spill_speedup",
+         "fabric_kv_replications", "fabric_kv_migrations",
+         "fabric_kv_push_bytes", "fabric_kv_refused_stale",
+         "fabric_secondary_hits")))
     parts = {}
     if prefix_cfg is not None:
         fields, parts["prefix"] = _serve_prefix_phase(prefix_cfg)
@@ -757,6 +954,9 @@ def bench_serve(requests: int = 32, concurrency: int = 4,
         fields, router_parts = _serve_router_phase(router_cfg)
         row.update(fields)
         parts.update(router_parts)
+    if fabric_cfg is not None:
+        fields, parts["fabric"] = _serve_fabric_phase(fabric_cfg)
+        row.update(fields)
 
     bl_cfg = _baseline_cfg()
     base_path = _baseline_snapshot_path(bl_cfg, "serve_bench",
@@ -772,6 +972,7 @@ def bench_serve(requests: int = 32, concurrency: int = 4,
                           "prefix_phase": prefix_cfg,
                           "spec_phase": spec_cfg,
                           "router_phase": router_cfg,
+                          "fabric_phase": fabric_cfg,
                           **cfg.config_row(seq_len)},
                # the wall-clock row rides in the committed artifact too:
                # the acceptance numbers (warm_speedup, spec_uplift,
@@ -1322,9 +1523,10 @@ def _cli(argv=None) -> int:
                          "phase")
     ap.add_argument("--engines", type=int, default=None, metavar="N",
                     help="bench_serve: sweep the ServeRouter fleet "
-                         "scaling phase over 1..N engines (ISSUE 14; "
-                         "default: the committed SERVE_ROUTER_PHASE "
-                         "fleet of 3; 0 skips the phase)")
+                         "scaling phase over 1..N engines (ISSUE 14) "
+                         "and run the N-engine KV-fabric phase "
+                         "(ISSUE 16; default: the committed fleet of "
+                         "3; 0 skips both phases)")
     ap.add_argument("--codec", default="none",
                     help="bench_ps commit codec: none|int8|bf16|topk<frac>")
     ap.add_argument("--down", default="none",
@@ -1386,6 +1588,9 @@ def _cli(argv=None) -> int:
             spec_phase=False if args.spec == 0
             else None if args.spec is None else {"k": args.spec},
             router_phase=False if args.engines == 0
+            else None if args.engines is None
+            else {"engines": args.engines},
+            fabric_phase=False if args.engines == 0
             else None if args.engines is None
             else {"engines": args.engines})))
         return 0

@@ -1,6 +1,6 @@
 """dklint rules — repo-specific static checks for a distributed-JAX stack.
 
-Eight rules, each targeting a hazard class this codebase actually has
+Nine rules, each targeting a hazard class this codebase actually has
 (ISSUE 3; the PS stack is exactly the shape of code where these corrupt
 training without failing a test):
 
@@ -42,6 +42,14 @@ training without failing a test):
   ``net.*`` byte counters.  A raw socket call elsewhere ships bytes the
   fault harness cannot reset, the byte ledgers never see, and the frame
   auto-detection cannot parse.
+* ``kv-version-guard`` — ``insert_remote(`` calls outside
+  ``serve/kvfabric.py`` (ISSUE 16): a remote KV pytree may only enter a
+  ``PrefixCache`` through the fabric's version-guarded seam
+  (``admit_remote_entry`` — checkpoint stamp checked before the insert
+  and re-checked after).  An insert elsewhere can land KV computed
+  under different weights, which then serves WRONG tokens — the one
+  fleet-cache bug no output test reliably catches, because the stale
+  entry only fires when its exact prefix recurs after a promote.
 """
 
 from __future__ import annotations
@@ -799,6 +807,45 @@ class WireSeamRule(Rule):
         ]
 
 
+# ---------------------------------------------------------------------------
+# kv-version-guard
+# ---------------------------------------------------------------------------
+
+
+class KvVersionGuardRule(Rule):
+    id = "kv-version-guard"
+    description = ("PrefixCache.insert_remote() outside serve/kvfabric.py "
+                   "— bypasses the checkpoint-version-stamped fabric seam "
+                   "and can serve KV computed under different weights")
+
+    #: attribute-call matching by name, the wire-seam pattern: the cache
+    #: object's spelling varies (self._prefix, engine._prefix, cache)
+    #: but the method name is the seam's contract
+    _METHODS = ("insert_remote",)
+    _SEAM = "serve/kvfabric.py"
+
+    def check(self, ctx: FileContext) -> List[Finding]:
+        rel = ctx.rel.replace("\\", "/")
+        if rel.endswith(self._SEAM) or rel == "kvfabric.py":
+            return []  # the version-guarded seam is the one caller
+        return [
+            self.finding(
+                ctx, node,
+                "remote KV inserted outside serve/kvfabric.py — "
+                "insert_remote() may only be called by the fabric's "
+                "admit_remote_entry seam, which checks the checkpoint "
+                "version stamp before the insert AND re-checks it after "
+                "(a stale push is refused, never joined); an insert "
+                "elsewhere can serve KV computed under different "
+                "weights, or disable with a pragma if the receiver is "
+                "not a PrefixCache")
+            for node in ast.walk(ctx.tree)
+            if isinstance(node, ast.Call) and
+            isinstance(node.func, ast.Attribute) and
+            node.func.attr in self._METHODS
+        ]
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     JitPurityRule(),
     LockDisciplineRule(),
@@ -808,6 +855,7 @@ ALL_RULES: Tuple[Rule, ...] = (
     StalenessProtocolRule(),
     ShmLifecycleRule(),
     WireSeamRule(),
+    KvVersionGuardRule(),
 )
 
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}

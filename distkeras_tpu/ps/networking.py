@@ -650,18 +650,23 @@ def send_msg(sock: socket.socket, obj: Any, registry=None,
 
 
 def send_stream(chan, parts: List[Tuple[List[Any], int]], registry=None,
-                count_as: Optional[str] = None) -> None:
+                count_as: Optional[str] = None,
+                action: str = "pull_stream") -> None:
     """One ``DKW4`` streamed pull reply (ISSUE 15): an announce frame
     (magic + chunk count), then the prologue and each chunk as ordinary
     :func:`send_packed` frames — the receiver decodes chunk k while
     chunk k+1 is still in flight.  ``parts`` is the pre-packed
     ``[prologue, chunk_0, ...]`` list (the pull cache's unit).
 
+    ``action`` names the stream for the chaos fault hook (ISSUE 16: the
+    serve KV fabric streams ``kv_fetch`` replies over this same seam,
+    and its faults must be addressable separately from PS pulls).
+
     On a negotiated :class:`ShmChannel` the chunks ride the ring only
     when the WHOLE stream fits at once (:meth:`ShmRing.stream_begin`);
     otherwise every frame of this reply stays on TCP — a per-chunk ring
     fallback could wrap onto an unread earlier chunk."""
-    _inject_fault("send", "pull_stream")
+    _inject_fault("send", action)
     sock, shm = _chan_parts(chan)
     reg = registry if registry is not None else default_registry()
     # however many frames carry it, a streamed reply is ONE message in

@@ -28,7 +28,7 @@ import numpy as np
 
 from ..obs import TIME_BUCKETS, Registry, default_registry
 from ..ps.networking import (client_handshake, connect,
-                             pinned_wire_version, recv_msg,
+                             pinned_wire_version, recv_msg, recv_pull,
                              retry_with_backoff, send_msg)
 
 
@@ -64,6 +64,10 @@ class ServeClient:
         self.wire_version = client_handshake(self.sock,
                                              registry=self.registry,
                                              want=self._want_version)
+        #: pooled receive arenas for streamed ``kv_fetch`` replies (the
+        #: DKW4 pull path, ISSUE 16) — steady-state fabric transfers
+        #: reuse one buffer instead of allocating multi-MB per fetch
+        self._kv_scratch: list = []
 
     def reconnect(self, attempts: int = 6, base_delay: float = 0.1,
                   max_delay: float = 2.0) -> None:
@@ -154,11 +158,54 @@ class ServeClient:
         though the connection died, and a resend would double-promote."""
         return self._rpc({"action": "promote", "variables": variables})
 
-    def drain(self, timeout_s: Optional[float] = None) -> dict:
-        """Ask the server to drain gracefully (idempotent)."""
+    def kv_fetch(self, prompt=None, hottest: Optional[int] = None,
+                 budget_bytes: Optional[int] = None) -> dict:
+        """Pull cached prefix KV from the service for the fleet fabric
+        (ISSUE 16): the longest cached entry matching ``prompt``
+        (replication-on-spill), or the ``hottest`` MRU entries bounded
+        by ``budget_bytes`` (migration off a draining engine).  Returns
+        ``{"ok", "found", "entries", "version"}`` — on a v2 connection
+        the reply arrives as a DKW4 chunked stream, its tensor leaves
+        decoded zero-copy into this client's pooled receive arena
+        (``recv_pull``, exactly the PS streamed-pull path).  No
+        auto-retry: the fabric re-fetches on its next spill instead."""
+        msg: dict = {"action": "kv_fetch"}
+        if hottest is not None:
+            msg["hottest"] = int(hottest)
+            if budget_bytes is not None:
+                msg["budget_bytes"] = int(budget_bytes)
+        else:
+            if prompt is None:
+                raise ValueError("kv_fetch needs a prompt or hottest")
+            msg["prompt"] = np.asarray(prompt, np.int32).reshape(-1)
+        send_msg(self.sock, msg, registry=self.registry,
+                 version=self.wire_version)
+        doc, _ = recv_pull(self.sock, registry=self.registry,
+                           scratch=self._kv_scratch)
+        return doc
+
+    def kv_push(self, entries, version: int) -> dict:
+        """Push exported KV ``entries`` (``kv_fetch`` documents) to the
+        service, stamped with the checkpoint ``version`` they were
+        computed under.  The service joins each through its
+        version-guarded fabric seam or refuses it — reply carries
+        ``joined`` / ``refused_stale`` / ``refused`` counts.  No
+        auto-retry (a reconnect-resend could double-push)."""
+        return self._rpc({"action": "kv_push", "entries": list(entries),
+                          "version": int(version)})
+
+    def drain(self, timeout_s: Optional[float] = None,
+              engine: Optional[str] = None) -> dict:
+        """Ask the server to drain gracefully (idempotent).  Against a
+        ``ServeRouter``, ``engine="host:port"`` names ONE backend for a
+        planned drain (its hot KV migrates to survivors, then the
+        victim drains and leaves rotation — the fleet keeps serving);
+        without it the whole front door drains."""
         msg: dict = {"action": "drain"}
         if timeout_s is not None:
             msg["timeout_s"] = float(timeout_s)
+        if engine is not None:
+            msg["engine"] = str(engine)
         return self._rpc(msg)
 
     def close(self) -> None:

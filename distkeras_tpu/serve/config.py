@@ -66,6 +66,14 @@ class ServeConfig:
       its content, so two prompts sharing a system prefix hit each
       other's entries without either being a strict prefix of the
       other.  Smaller blocks match more, cost more lookup hashing.
+    * ``kv_fabric`` — ISSUE 16: answer the fleet KV fabric's
+      ``kv_fetch``/``kv_push`` RPCs (export cached prefix KV to peer
+      engines, admit version-stamped pushes from them).  Requires the
+      prefix cache; on a cache-less engine the RPCs answer "disabled"
+      and the router's fabric simply never warms spills to it.  Off
+      turns an engine into a fabric island — its cache neither
+      replicates out nor accepts pushes (e.g. an engine serving a
+      different checkpoint lineage).
     * ``spec_k`` — ISSUE 11 decode accelerator #2: speculative decoding.
       0 disables; k >= 1 makes a small *draft* model (passed to
       ``DecodeEngine``) propose k tokens per active row per step, which
@@ -90,6 +98,7 @@ class ServeConfig:
     prefix_cache: bool = False
     prefix_cache_mb: float = 64.0
     prefix_block: int = 16
+    kv_fabric: bool = True
     spec_k: int = 0
 
     def __post_init__(self):
@@ -169,5 +178,6 @@ class ServeConfig:
             if self.prefix_cache else None,
             "prefix_block": int(self.prefix_block)
             if self.prefix_cache else None,
+            "kv_fabric": bool(self.kv_fabric and self.prefix_cache),
             "spec_k": int(self.spec_k),
         }

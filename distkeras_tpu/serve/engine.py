@@ -81,7 +81,7 @@ from __future__ import annotations
 import collections
 import threading
 import time
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -292,7 +292,8 @@ class DecodeEngine:
         self._c_spec_proposed = reg.counter("serve.spec.proposed")
         self._c_spec_accepted = reg.counter("serve.spec.accepted")
         self._g_accept_rate = reg.gauge("serve.spec.accept_rate")
-        for name in ("hits", "misses", "inserts", "evictions"):
+        for name in ("hits", "misses", "inserts", "remote_inserts",
+                     "evictions"):
             reg.counter(f"serve.prefix.{name}")
         reg.gauge("serve.prefix.bytes")
         reg.gauge("serve.prefix.entries")
@@ -301,6 +302,14 @@ class DecodeEngine:
             self._prefix = PrefixCache(
                 int(float(self.config.prefix_cache_mb) * 1024 * 1024),
                 reg, block=int(self.config.prefix_block))
+        #: KV checkpoint version (ISSUE 16): bumped by the DECODE thread
+        #: at promotion adoption — the moment the weights that compute
+        #: new cache entries actually change — so a fabric export/import
+        #: double-reading it around a cache touch can prove which
+        #: weight generation an entry belongs to (see kv_export /
+        #: serve.kvfabric.admit_remote_entry).  ``_c_promotions`` keeps
+        #: its caller-side count-of-promote-calls semantics.
+        self._kv_version = 0
 
         #: admission queue + flags — the ONLY state shared across threads;
         #: every touch goes through _lock (slot table and device state are
@@ -763,13 +772,144 @@ class DecodeEngine:
             new = self._pending_variables
             self._pending_variables = None
         if new is not None:
-            self._variables = new
             if self._prefix is not None:
                 # close the promote()-to-adoption race: any entry a
                 # concurrent admit inserted under the OLD weights after
                 # the caller-side flush dies here, before the new
                 # weights serve a single token
                 self._prefix.flush()
+            # flush -> bump -> swap, all on the decode thread (the only
+            # inserter), is what makes the KV version stamp exact
+            # (ISSUE 16): an entry visible while _kv_version reads v was
+            # inserted before this flush under the OLD weights (gen v);
+            # one visible after the bump was inserted after the swap
+            # under the NEW weights (gen v+1) — no interleaving can put
+            # an insert between these three statements.  kv_export's
+            # read-version / peek / re-read-version sequence (and the
+            # fabric's check-insert-recheck) therefore refuses every
+            # cross-generation race instead of mis-stamping it.
+            self._kv_version += 1
+            self._variables = new
+
+    # -- KV fabric (ISSUE 16): cached prefix KV as a fleet resource ---------
+    @property
+    def kv_version(self) -> int:
+        """The serving checkpoint generation KV transfers are stamped
+        with — bumped at promotion ADOPTION, the moment newly inserted
+        cache entries start being computed under the new weights (see
+        ``_adopt_promotion``)."""
+        return int(self._kv_version)
+
+    def _entry_doc(self, entry: PrefixEntry) -> dict:
+        """One cache entry as a host-side wire document (device -> host
+        readback; the arrays ride the v2 zero-copy tensor frames)."""
+        import jax
+        doc = {"host_tokens": np.asarray(entry.host_tokens, np.int32),
+               "cache": jax.tree_util.tree_map(np.asarray, entry.cache)}
+        if entry.draft_cache is not None:
+            doc["draft_cache"] = jax.tree_util.tree_map(
+                np.asarray, entry.draft_cache)
+        return doc
+
+    def kv_export(self, prompt) -> Optional[dict]:
+        """The longest cached prefix entry for ``prompt`` as a wire doc
+        ``{"entries": [...], "version": v}`` — what the ``kv_fetch`` RPC
+        answers a replication-on-spill request with.  Returns ``None``
+        when the cache is off/cold for this prompt, or when a promotion
+        raced the export: the version is read before AND after the cache
+        probe, and a mismatch means the probed entry's weight generation
+        is ambiguous — refusing to ship it is the conservative side of
+        the never-join-stale-KV contract."""
+        if self._prefix is None:
+            return None
+        v0 = self._kv_version
+        hit = self._prefix.peek(np.asarray(prompt, np.int32).reshape(-1))
+        if hit is None:
+            return None
+        entry, _ = hit
+        doc = {"entries": [self._entry_doc(entry)], "version": int(v0)}
+        if self._kv_version != v0:
+            return None
+        return doc
+
+    def kv_export_hottest(self, max_entries: int,
+                          budget_bytes: int) -> Optional[dict]:
+        """The MRU-side working set as a wire doc — what a draining /
+        soon-to-be-evicted engine answers a migration ``kv_fetch`` with
+        (hottest first, entry- and byte-bounded by the CALLER's budget).
+        Same double-read promotion refusal as :meth:`kv_export`."""
+        if self._prefix is None:
+            return None
+        v0 = self._kv_version
+        entries = self._prefix.hottest(max_entries, budget_bytes)
+        if not entries:
+            return None
+        doc = {"entries": [self._entry_doc(e) for e in entries],
+               "version": int(v0)}
+        if self._kv_version != v0:
+            return None
+        return doc
+
+    def kv_import(self, doc: dict, version: int) -> Tuple[bool, str]:
+        """Admit ONE peer-exported cache entry (an ``_entry_doc``)
+        stamped with checkpoint ``version``; returns ``(joined,
+        reason)``.  Validation mirrors ``promote()``'s caller-thread
+        discipline: tree leaves are checked against this engine's own
+        single-row cache template HERE, so the decode thread can never
+        trip over a foreign-model tree.  The stale-version refusal
+        itself (checked before and after the insert) lives in the
+        ``serve.kvfabric`` seam — the only legitimate ``insert_remote``
+        caller (dklint rule 9, ``kv-version-guard``)."""
+        import jax
+        import jax.numpy as jnp
+        from .kvfabric import admit_remote_entry
+
+        if self._prefix is None:
+            return False, "prefix cache disabled"
+        # copy out of the receive arena: a retained view would pin the
+        # pooled multi-MB buffer for the lifetime of the cache entry
+        host_tokens = np.array(doc.get("host_tokens"),
+                               np.int32).reshape(-1)
+        length = int(host_tokens.shape[0])
+        if not 1 <= length <= self._t:
+            return False, f"entry length {length} outside [1, {self._t}]"
+
+        def _device_tree(got, template, what):
+            tleaves, tdef = jax.tree_util.tree_flatten(template)
+            leaves = [np.asarray(leaf) for leaf in
+                      jax.tree_util.tree_leaves(got)]
+            if len(leaves) != len(tleaves):
+                raise ValueError(f"{what}: {len(leaves)} leaves != "
+                                 f"{len(tleaves)} expected")
+            bad = [f"{g.shape}/{g.dtype} != {t.shape}/{t.dtype}"
+                   for g, t in zip(leaves, tleaves)
+                   if g.shape != t.shape or g.dtype != t.dtype]
+            if bad:
+                raise ValueError(f"{what} leaf mismatch: "
+                                 f"{'; '.join(bad[:3])}"
+                                 f"{' ...' if len(bad) > 3 else ''}")
+            return jax.tree_util.tree_unflatten(
+                tdef, [jnp.asarray(leaf) for leaf in leaves])
+
+        try:
+            cache = _device_tree(doc.get("cache"),
+                                 self._single_row_cache(self._cache),
+                                 "cache")
+            if self._spec_k > 0:
+                if doc.get("draft_cache") is None:
+                    return False, "draft cache missing (spec_k > 0)"
+                draft_cache = _device_tree(
+                    doc.get("draft_cache"),
+                    self._single_row_cache(self._dcache), "draft cache")
+            else:
+                draft_cache = None
+        except (ValueError, TypeError) as e:
+            return False, str(e)
+        tokens = np.zeros((1, self._t), np.int32)
+        tokens[0, :length] = host_tokens
+        entry = PrefixEntry(host_tokens, jnp.asarray(tokens), cache,
+                            draft_cache)
+        return admit_remote_entry(self, entry, int(version))
 
     # -- admission ----------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,

@@ -123,6 +123,8 @@ class PrefixCache:
         self._c_hits = registry.counter("serve.prefix.hits")
         self._c_misses = registry.counter("serve.prefix.misses")
         self._c_inserts = registry.counter("serve.prefix.inserts")
+        self._c_remote_inserts = registry.counter(
+            "serve.prefix.remote_inserts")
         self._c_evictions = registry.counter("serve.prefix.evictions")
         self._g_bytes = registry.gauge("serve.prefix.bytes")
         self._g_entries = registry.gauge("serve.prefix.entries")
@@ -189,6 +191,79 @@ class PrefixCache:
             self._entries.move_to_end(primary)
             self._c_hits.inc()
             return self._entries[primary], min(length, n - 1)
+
+    def peek(self, prompt: np.ndarray) -> Optional[tuple]:
+        """The longest cached prefix of ``prompt`` as ``(entry,
+        matched_len)`` WITHOUT observing it: no hit/miss counters, no
+        LRU refresh, no ``n - 1`` cap.  The KV-fabric export path
+        (ISSUE 16) reads through this — the router's affinity-decay
+        validation compares ``serve.prefix.hits``/``misses`` against
+        routed traffic, and a fabric export probing the cache must not
+        pollute that signal (or reorder the LRU the migration exporter
+        is about to walk)."""
+        prompt = np.asarray(prompt, np.int32)
+        n = int(prompt.shape[0])
+        if n < 1:
+            return None
+        with self._lock:
+            lengths = sorted(self._lengths)
+        data = np.ascontiguousarray(prompt).tobytes()
+        digests = []
+        h = hashlib.sha1()
+        hashed = 0
+        for length in lengths:
+            if length > n:
+                break
+            h.update(data[hashed:length * 4])
+            hashed = length * 4
+            digests.append((length, h.copy().digest()))
+        with self._lock:
+            best = None
+            for length, digest in digests:
+                primary = self._alias.get((length, digest))
+                if primary is None:
+                    continue
+                entry = self._entries[primary]
+                if not np.array_equal(entry.host_tokens[:length],
+                                      prompt[:length]):
+                    continue
+                best = (primary, length)
+            if best is None:
+                return None
+            primary, length = best
+            return self._entries[primary], length
+
+    def hottest(self, max_entries: int, budget_bytes: int) -> list:
+        """The MRU-side entries, most-recently-used first, stopping at
+        ``max_entries`` or ``budget_bytes`` — the migration exporter's
+        unit (ISSUE 16): a draining/evicting engine ships its hottest
+        working set to survivors, bounded so a big cache never stalls
+        the planned transition behind a bulk transfer."""
+        out: list = []
+        total = 0
+        with self._lock:
+            for primary in reversed(self._entries):
+                entry = self._entries[primary]
+                if len(out) >= int(max_entries) or \
+                        total + entry.nbytes > int(budget_bytes):
+                    break
+                out.append(entry)
+                total += entry.nbytes
+        return out
+
+    def insert_remote(self, entry: PrefixEntry) -> None:
+        """Insert an entry whose KV arrived OVER THE WIRE from a peer
+        engine — the KV-fabric landing seam (ISSUE 16), counted
+        separately (``serve.prefix.remote_inserts``) so a snapshot shows
+        how much of the cache was replicated vs locally computed.
+
+        dklint rule 9 (``kv-version-guard``) restricts callers to
+        ``serve/kvfabric.py``: remote KV is only valid under the
+        checkpoint version it was computed for, and that stamp is
+        checked (before AND after the insert) only inside the fabric
+        seam — any other call site could join stale KV."""
+        self._c_remote_inserts.inc()
+        self.insert(entry)
 
     def insert(self, entry: PrefixEntry) -> None:
         """Insert (dedup by content: an existing identical entry is only

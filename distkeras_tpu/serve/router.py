@@ -55,13 +55,31 @@ forwards each ``generate`` to one backend engine over pooled persistent
   router-level accounting stays exact:
   ``serve.router.requests == completed + rejected``.
 
+**KV fabric (ISSUE 16):** the affinity table holds up to TWO owners per
+prefix (primary + replicated secondary).  A routed request whose
+longest mapped prefix belongs to a live engine it was NOT sent to is a
+**spill** — the router enqueues a ``serve.kvfabric.KVFabric``
+replication (fetch the owner's cache entry, push it to the spill
+target, single-flight + budget-bounded), records the target as a
+secondary owner on completion so repeat overflow routes warm
+(``serve.router.affinity_secondary_hits``), and splits the spilled
+request's engine-reported TTFT into
+``serve.router.ttft_spill_warm_seconds`` /
+``ttft_spill_cold_seconds`` by the engine's prefix-cache outcome — the
+warm-vs-cold spill proof pair.  Planned transitions migrate instead of
+discard: ``drain`` with an ``engine`` address migrates the victim's
+hottest entries to survivors before draining it, and a router evict
+enqueues the same migration best-effort.
+
 Metrics (router registry, all pre-created): counters
 ``serve.router.{requests,completed,rejected}`` (rejected split
 ``_no_backend`` / ``_backend`` / ``_error`` / ``_draining``),
 ``serve.router.{requeues,evictions,rejoins}``,
-``serve.router.affinity_{hits,misses,decays}``,
-``serve.router.{promotes,promote_failures,promote_rollforwards}``;
-histograms ``serve.router.e2e_seconds`` / ``route_seconds``; gauges
+``serve.router.affinity_{hits,misses,decays,secondary_hits}``,
+``serve.router.{promotes,promote_failures,promote_rollforwards}``,
+``serve.router.kv_{replications,migrations,push_bytes,refused_stale}``;
+histograms ``serve.router.e2e_seconds`` / ``route_seconds`` /
+``ttft_spill_warm_seconds`` / ``ttft_spill_cold_seconds``; gauges
 ``serve.router.engines_alive`` / ``affinity_entries`` /
 ``affinity_hit_rate`` (the fleet-wide engine-measured prefix hit rate
 the ``obsview`` MISROUTED alarm watches).
@@ -83,6 +101,7 @@ from ..obs import Registry, TIME_BUCKETS
 from ..obs.logging import get_logger
 from ..ps.networking import WIRE_VERSION, FrameServer
 from .client import ServeClient
+from .kvfabric import KVFabric
 
 _LOG = "serve.router"
 
@@ -137,6 +156,20 @@ class RouterConfig:
       blackholing SYNs must cost the router seconds, not client-grade
       patience — the sequential health poller and any in-flight forward
       wait behind the dial).
+    * ``kv_fabric`` — ISSUE 16: run the fleet KV fabric (hot-prefix
+      replication on spill, KV migration on planned drain/evict).  Off
+      keeps routing identical but every spill cold-prefills and every
+      evict discards its cache.
+    * ``kv_fabric_mb`` — in-flight transfer budget: the fabric never
+      holds more than this many MB of fetched-but-not-yet-pushed KV
+      (a fetch that would exceed it is skipped, retried on the next
+      spill).
+    * ``kv_link_inflight`` — per ``(owner, target)`` link cap on
+      queued+running replication jobs: a spill storm between two
+      engines collapses to this many transfers, the rest dedup away.
+    * ``kv_migrate_entries`` — how many MRU entries a planned
+      drain/evict migrates off the victim (still bounded by
+      ``kv_fabric_mb`` bytes).
     """
 
     affinity_block: int = 16
@@ -150,11 +183,19 @@ class RouterConfig:
     request_timeout_s: Optional[float] = None
     connect_retries: int = 2
     dial_timeout_s: float = 2.0
+    kv_fabric: bool = True
+    kv_fabric_mb: float = 64.0
+    kv_link_inflight: int = 1
+    kv_migrate_entries: int = 8
 
     def __post_init__(self):
+        if not float(self.kv_fabric_mb) > 0:
+            raise ValueError(f"kv_fabric_mb must be > 0, got "
+                             f"{self.kv_fabric_mb}")
         for name in ("affinity_block", "affinity_max_blocks",
                      "affinity_max", "max_inflight", "evict_failures",
-                     "connect_retries"):
+                     "connect_retries", "kv_link_inflight",
+                     "kv_migrate_entries"):
             if int(getattr(self, name)) < 1:
                 raise ValueError(f"{name} must be >= 1, got "
                                  f"{getattr(self, name)}")
@@ -262,9 +303,12 @@ class ServeRouter(FrameServer):
         self.backends = [_Backend(h, p, i)
                          for i, (h, p) in
                          enumerate(_parse_targets(engines))]
-        #: routing state lock: backend bookkeeping + the affinity table
+        #: routing state lock: backend bookkeeping + the affinity table.
+        #: Values are OWNER LISTS (ISSUE 16): up to two engine idxs per
+        #: prefix key, primary first — the secondary is a fabric
+        #: replication target that now holds the same KV
         self._lock = threading.Lock()
-        self._affinity: "OrderedDict[tuple, int]" = OrderedDict()
+        self._affinity: "OrderedDict[tuple, list]" = OrderedDict()
         self._draining = False
         #: serializes promote fan-outs and guards the roll-forward tree
         self._promote_lock = threading.Lock()
@@ -288,6 +332,14 @@ class ServeRouter(FrameServer):
         self._c_aff_hits = reg.counter("serve.router.affinity_hits")
         self._c_aff_misses = reg.counter("serve.router.affinity_misses")
         self._c_aff_decays = reg.counter("serve.router.affinity_decays")
+        self._c_aff_secondary = reg.counter(
+            "serve.router.affinity_secondary_hits")
+        self._c_kv_replications = reg.counter(
+            "serve.router.kv_replications")
+        self._c_kv_migrations = reg.counter("serve.router.kv_migrations")
+        self._c_kv_push_bytes = reg.counter("serve.router.kv_push_bytes")
+        self._c_kv_refused_stale = reg.counter(
+            "serve.router.kv_refused_stale")
         self._c_promotes = reg.counter("serve.router.promotes")
         self._c_promote_failures = reg.counter(
             "serve.router.promote_failures")
@@ -297,10 +349,19 @@ class ServeRouter(FrameServer):
                                     TIME_BUCKETS)
         self._h_route = reg.histogram("serve.router.route_seconds",
                                       TIME_BUCKETS)
+        self._h_ttft_spill_warm = reg.histogram(
+            "serve.router.ttft_spill_warm_seconds", TIME_BUCKETS)
+        self._h_ttft_spill_cold = reg.histogram(
+            "serve.router.ttft_spill_cold_seconds", TIME_BUCKETS)
         self._g_alive = reg.gauge("serve.router.engines_alive")
         self._g_alive.set(len(self.backends))
         self._g_aff_entries = reg.gauge("serve.router.affinity_entries")
         self._g_aff_rate = reg.gauge("serve.router.affinity_hit_rate")
+
+        #: ISSUE 16: the fleet KV fabric (replication on spill,
+        #: migration on drain/evict); None when configured off
+        self._kv_fabric: Optional[KVFabric] = \
+            KVFabric(self) if self.config.kv_fabric else None
 
     # -- backend connections ------------------------------------------------
     def _acquire(self, be: _Backend) -> ServeClient:
@@ -333,21 +394,37 @@ class ServeRouter(FrameServer):
         return be.alive and be.idx not in exclude \
             and be.inflight < int(self.config.max_inflight)
 
-    def _route(self, prompt: np.ndarray, exclude=frozenset()):
+    def _route(self, prompt: np.ndarray, exclude=frozenset(),
+               spill_out: Optional[list] = None):
         """Pick a backend for ``prompt``: affinity first, least-loaded
         otherwise; registers the routed keys and takes an in-flight
         slot.  Returns ``(backend, was_affine)`` or ``(None, False)``
-        when no engine is admissible."""
+        when no engine is admissible.
+
+        ISSUE 16: overflow routes report to ``spill_out`` (when given).
+        A pick that is NOT an owner of the longest mapped prefix while
+        a live owner exists appends ``("spill", key, owner_idx,
+        target_idx)`` — the fabric's replication trigger; a pick that is
+        the replicated SECONDARY owner appends ``("secondary", ...)`` —
+        already-replicated overflow, no new transfer, but still spill
+        traffic for the warm-vs-cold TTFT split."""
         t0 = time.perf_counter()
         keys = self._affinity_keys(prompt)
         with self._lock:
-            target, affine = None, False
+            target, affine, sec_spill = None, False, None
             for key in keys:
-                idx = self._affinity.get(key)
-                if idx is not None and \
-                        self._admissible(self.backends[idx], exclude):
-                    target, affine = self.backends[idx], True
-                    self._affinity.move_to_end(key)
+                owners = self._affinity.get(key)
+                if not owners:
+                    continue
+                for rank, idx in enumerate(owners):
+                    if self._admissible(self.backends[idx], exclude):
+                        target, affine = self.backends[idx], True
+                        if rank > 0:
+                            sec_spill = ("secondary", key, owners[0],
+                                         idx)
+                        self._affinity.move_to_end(key)
+                        break
+                if target is not None:
                     break
             if target is None:
                 cands = [be for be in self.backends
@@ -363,19 +440,49 @@ class ServeRouter(FrameServer):
                                              + be.active_slots,
                                              be.requests, be.idx))
             (self._c_aff_hits if affine else self._c_aff_misses).inc()
+            if sec_spill is not None:
+                self._c_aff_secondary.inc()
+            spill = None
+            seen_mapped = False
             for key in keys:
-                cur = self._affinity.get(key)
-                if cur is not None and cur != target.idx \
-                        and self.backends[cur].alive:
+                owners = self._affinity.get(key)
+                if not owners:
+                    self._affinity[key] = [target.idx]
+                    self._affinity.move_to_end(key)
+                    continue
+                longest_mapped = not seen_mapped
+                seen_mapped = True
+                if target.idx in owners:
+                    self._affinity.move_to_end(key)
+                    continue
+                live = [i for i in owners if self.backends[i].alive]
+                if live:
                     # a LIVE engine already owns this prefix: a
                     # transient spill (owner at its in-flight bound)
                     # must not steal the mapping and strand the owner's
                     # warm KV — the owner serves the prefix again the
                     # moment it is admissible.  Dead owners' keys were
-                    # purged at eviction; stale live mappings decay
+                    # purged at eviction; stale live mappings decay.
+                    # The LONGEST foreign-owned mapped key is the KV
+                    # fabric's replication trigger — shorter keys under
+                    # a target-owned longer one are not (the target
+                    # already holds a covering entry).  Only a SINGLY-
+                    # owned prefix replicates: once a replica exists
+                    # (two live owners) a further overflow means the
+                    # whole fleet is saturated, and shipping a third
+                    # copy would evict the second and thrash transfer
+                    # bandwidth without adding warm capacity
+                    if longest_mapped and spill is None \
+                            and len(live) == 1:
+                        spill = ("spill", key, live[0], target.idx)
                     continue
-                self._affinity[key] = target.idx
+                self._affinity[key] = [target.idx]
                 self._affinity.move_to_end(key)
+            if spill_out is not None:
+                if spill is not None:
+                    spill_out.append(spill)
+                elif sec_spill is not None:
+                    spill_out.append(sec_spill)
             while len(self._affinity) > int(self.config.affinity_max):
                 self._affinity.popitem(last=False)
             self._g_aff_entries.set(len(self._affinity))
@@ -387,15 +494,65 @@ class ServeRouter(FrameServer):
         self._h_route.observe(time.perf_counter() - t0)
         return target, affine
 
+    def _add_secondary(self, key, idx: int) -> None:
+        """Record engine ``idx`` as a secondary owner of affinity
+        ``key`` — the fabric's post-replication hook, bounding each
+        prefix to TWO owners (primary + the freshest replica; a third
+        replication replaces the older secondary)."""
+        with self._lock:
+            owners = self._affinity.get(key)
+            if owners is None:
+                # the key aged out of the LRU while the transfer ran:
+                # the replica is real, so re-map it as primary
+                self._affinity[key] = [int(idx)]
+                self._g_aff_entries.set(len(self._affinity))
+                return
+            if int(idx) in owners:
+                return
+            if len(owners) >= 2:
+                owners[-1] = int(idx)
+            else:
+                owners.append(int(idx))
+
+    def _reown_affinity(self, host_tokens: np.ndarray, victim_idx: int,
+                        new_idx: int) -> None:
+        """Re-point a migrated entry's affinity keys from ``victim_idx``
+        at its recipient ``new_idx`` (the fabric's post-migration hook):
+        traffic for the moved prefix follows the KV to the survivor
+        instead of cold-starting wherever least-loaded lands it."""
+        keys = self._affinity_keys(
+            np.asarray(host_tokens, np.int32).reshape(-1))
+        with self._lock:
+            for key in keys:
+                owners = self._affinity.get(key)
+                if owners is None:
+                    self._affinity[key] = [int(new_idx)]
+                elif int(new_idx) in owners:
+                    if int(victim_idx) in owners:
+                        owners.remove(int(victim_idx))
+                elif int(victim_idx) in owners:
+                    owners[owners.index(int(victim_idx))] = int(new_idx)
+                elif len(owners) < 2:
+                    owners.append(int(new_idx))
+            self._g_aff_entries.set(len(self._affinity))
+
     def _drop_affinity(self, idx: int) -> int:  # dklint: holds=_lock
-        dropped = [k for k, i in self._affinity.items() if i == idx]
-        for k in dropped:
-            del self._affinity[k]
+        dropped = 0
+        for k in [k for k, owners in self._affinity.items()
+                  if idx in owners]:
+            owners = self._affinity[k]
+            owners.remove(idx)
+            dropped += 1
+            if not owners:
+                # a surviving co-owner keeps the key: its replica of
+                # the prefix is still warm and still routable
+                del self._affinity[k]
         self._g_aff_entries.set(len(self._affinity))
-        return len(dropped)
+        return dropped
 
     # -- eviction / rejoin --------------------------------------------------
-    def _evict(self, be: _Backend, reason: str) -> None:
+    def _evict(self, be: _Backend, reason: str,
+               migrate: bool = True) -> None:
         with self._lock:
             if not be.alive:
                 return
@@ -405,6 +562,13 @@ class ServeRouter(FrameServer):
             dropped = self._drop_affinity(be.idx)
             self._g_alive.set(sum(b.alive for b in self.backends))
         be.close_pool()
+        if migrate and self._kv_fabric is not None:
+            # best-effort KV rescue (ISSUE 16): a DEAD victim fails the
+            # fabric's fetch fast and the job ends silently; a wedged-
+            # but-answering one still gets its warm set copied to
+            # survivors.  The planned-drain path passes migrate=False —
+            # it already migrated synchronously, before the drain
+            self._kv_fabric.note_eviction(be.idx)
         get_logger(_LOG).warning(
             "evicted engine %s (%s); %d affinity entries dropped, "
             "traffic re-queued to survivors", be.addr, reason, dropped)
@@ -432,6 +596,12 @@ class ServeRouter(FrameServer):
         with self._lock:
             be.fails = 0
             if not be.alive:
+                if reply.get("draining"):
+                    # a planned-drained engine still answers stats but
+                    # admits NOTHING — rejoining it would only bounce
+                    # traffic off its "draining" rejection.  It stays
+                    # evicted until it answers un-draining (a restart)
+                    return
                 be.alive = True
                 rejoined = True
                 self._c_rejoins.inc()
@@ -616,7 +786,10 @@ class ServeRouter(FrameServer):
         t0 = time.perf_counter()
         tried: set = set()
         while True:
-            be, _affine = self._route(prompt, exclude=tried)
+            spill: list = []
+            be, _affine = self._route(
+                prompt, exclude=tried,
+                spill_out=spill if self._kv_fabric is not None else None)
             if be is None:
                 self._c_rejected.inc()
                 self._c_rej_nobackend.inc()
@@ -652,6 +825,15 @@ class ServeRouter(FrameServer):
             if reply.get("ok"):
                 self._c_completed.inc()
                 self._h_e2e.observe(time.perf_counter() - t0)
+                if spill and reply.get("ttft_s") is not None \
+                        and reply.get("warm") is not None:
+                    # the warm-vs-cold spill TTFT split (ISSUE 16): the
+                    # engine reports its admit-time prefix outcome, so
+                    # a spill that landed AFTER the fabric replicated
+                    # reads warm — the fabric's payoff, measured
+                    (self._h_ttft_spill_warm if reply["warm"]
+                     else self._h_ttft_spill_cold).observe(
+                        float(reply["ttft_s"]))
             else:
                 if reply.get("rejected") and \
                         reply.get("reason") in ("queue full", "draining"):
@@ -673,6 +855,20 @@ class ServeRouter(FrameServer):
                     # "error": counted here so the router's
                     # requests == completed + rejected stays exact
                     self._c_rej_error.inc()
+            if spill and spill[0][0] == "spill":
+                # the pick was NOT an owner of this prompt's longest
+                # mapped prefix: replicate the owner's KV to it so the
+                # NEXT overflow of this prefix lands warm.  Triggered
+                # AFTER this request's reply so the transfer can never
+                # race its admission (the spilled request is cold by
+                # construction — the proof split stays exact) and the
+                # fetch never steals CPU from the very prefill it is
+                # trying to make unnecessary.  ("secondary" overflow
+                # already holds the replica — no new transfer, just
+                # the TTFT attribution above)
+                _kind, key, owner_idx, target_idx = spill[0]
+                self._kv_fabric.note_spill(key, owner_idx, target_idx,
+                                           prompt)
             reply["engine"] = be.addr
             return reply
 
@@ -749,9 +945,49 @@ class ServeRouter(FrameServer):
                 "slots": slots, "queue_depth": queue_depth,
                 "active_slots": active, "draining": draining}
 
+    def _drain_engine(self, addr: str, timeout_s) -> dict:
+        """Planned SINGLE-engine drain (ISSUE 16): migrate the victim's
+        hottest KV entries to survivors synchronously — the warm set
+        crosses the wire while the victim still answers — THEN drain it
+        and take it out of rotation.  The fleet keeps serving; the
+        victim's prefixes keep hitting, now on the recipients."""
+        be = next((b for b in self.backends if b.addr == addr), None)
+        if be is None:
+            return {"ok": False, "error": f"unknown engine {addr!r}"}
+        with self._lock:
+            alive = be.alive
+        if not alive:
+            return {"ok": False, "engine": be.addr,
+                    "error": "engine already evicted"}
+        migrated = 0
+        if self._kv_fabric is not None:
+            migrated = self._kv_fabric.migrate_now(be.idx)
+        try:
+            client = self._acquire(be)
+            try:
+                result = client.drain(timeout_s)
+            except BaseException:
+                client.close()
+                raise
+            be.release(client)
+        except (ConnectionError, OSError, socket.timeout) as e:
+            result = {"ok": False, "error": str(e)}
+        self._evict(be, "planned drain", migrate=False)
+        reply = {"ok": bool(result.get("ok")), "engine": be.addr,
+                 "migrated": migrated,
+                 "drained": result.get("drained")}
+        if result.get("error"):
+            reply["error"] = result["error"]
+        return reply
+
     def _handle_drain(self, msg: dict) -> dict:
         """Fleet drain: stop admitting at the front door, then fan the
-        drain to every live engine (idempotent, like the engine's)."""
+        drain to every live engine (idempotent, like the engine's).
+        With an ``engine`` address (ISSUE 16) it is instead a PLANNED
+        single-engine drain — migrate-then-drain, fleet stays up."""
+        addr = msg.get("engine")
+        if addr is not None:
+            return self._drain_engine(str(addr), msg.get("timeout_s"))
         with self._lock:
             self._draining = True
         results = {}
@@ -792,6 +1028,8 @@ class ServeRouter(FrameServer):
             target=self._poll_loop, daemon=True,
             name="serve-router-poll")
         self._poll_thread.start()
+        if self._kv_fabric is not None:
+            self._kv_fabric.start()
 
     def _before_close_connections(self) -> None:
         # let handler threads flush replies for forwards that are about
@@ -801,6 +1039,10 @@ class ServeRouter(FrameServer):
             time.sleep(0.01)
 
     def stop(self) -> None:
+        if self._kv_fabric is not None:
+            # before the poller and listener: in-flight transfers
+            # finish or die with their sockets, no new jobs enqueue
+            self._kv_fabric.stop()
         self._poll_stop.set()
         if self._poll_thread is not None:
             self._poll_thread.join(timeout=5)

@@ -41,7 +41,9 @@ from typing import Optional
 
 import numpy as np
 
-from ..ps.networking import WIRE_VERSION, FrameServer
+from ..ps.networking import (REPLY_SENT, STREAM_CHUNK_BYTES,
+                             WIRE_VERSION, FrameServer, pack_stream,
+                             send_stream)
 from .engine import DecodeEngine, ServeRejected
 
 
@@ -128,6 +130,12 @@ class ServeServer(FrameServer):
             reply["queue_wait_s"] = req.admit_t - req.submit_t
         if req.first_token_t is not None:
             reply["ttft_s"] = req.first_token_t - req.submit_t
+        if req.warm is not None:
+            # the admit-time prefix-cache outcome (ISSUE 16): the router
+            # splits its spill TTFT histograms on this — a spill that
+            # warm-joined proves the fabric replicated in time.  Old
+            # clients ignore the key, per the wire's extension contract
+            reply["warm"] = bool(req.warm)
         return reply
 
     def _handle_promote(self, msg: dict) -> dict:
@@ -146,6 +154,73 @@ class ServeServer(FrameServer):
                 "promotions":
                     int(self.engine._c_promotions.value)}
 
+    def _handle_kv_fetch(self, msg: dict, ver: int, conn) -> object:
+        """Export cached prefix KV for the fleet fabric (ISSUE 16):
+        the longest entry matching ``prompt`` (replication-on-spill),
+        or the ``hottest`` MRU entries within ``budget_bytes``
+        (migration).  On a v2 connection the reply — megabytes of KV —
+        rides the ``DKW4`` chunked stream frame (the PR 15 pull path,
+        reused): the peer decodes chunk k while k+1 is in flight,
+        landing the leaves in its pooled receive arena.  v1 peers get
+        the same document monolithic."""
+        if not self.engine.config.kv_fabric:
+            return {"ok": False, "error": "kv fabric disabled"}
+        hottest = msg.get("hottest")
+        if hottest is not None:
+            doc = self.engine.kv_export_hottest(
+                int(hottest),
+                int(msg.get("budget_bytes") or 64 * 1024 * 1024))
+        else:
+            prompt = msg.get("prompt")
+            if prompt is None:
+                return {"ok": False,
+                        "error": "kv_fetch needs a prompt or hottest"}
+            doc = self.engine.kv_export(np.asarray(prompt))
+        reply = {"ok": True, "found": doc is not None,
+                 "entries": (doc or {}).get("entries", []),
+                 "version": (doc or {}).get(
+                     "version", self.engine.kv_version)}
+        if ver >= 2 and doc is not None:
+            send_stream(
+                conn, pack_stream(reply, STREAM_CHUNK_BYTES, version=ver),
+                registry=self.registry,
+                count_as=f"{self.metric_prefix}.wire.bytes_down",
+                action="kv_fetch_stream")
+            return REPLY_SENT
+        return reply
+
+    def _handle_kv_push(self, msg: dict) -> dict:
+        """Admit peer-exported KV entries stamped with a checkpoint
+        ``version`` (ISSUE 16).  Every entry either joins through the
+        version-guarded ``serve.kvfabric`` seam or is refused with a
+        reason — a stale stamp is refused, never joined."""
+        if not self.engine.config.kv_fabric:
+            return {"ok": False, "error": "kv fabric disabled"}
+        entries = msg.get("entries")
+        if not entries:
+            return {"ok": False, "error": "kv_push needs entries"}
+        version = msg.get("version")
+        if version is None:
+            return {"ok": False,
+                    "error": "kv_push needs a version stamp"}
+        joined = refused_stale = refused_other = 0
+        reason = None
+        for doc in entries:
+            ok, why = self.engine.kv_import(doc, int(version))
+            if ok:
+                joined += 1
+            elif why == "stale":
+                refused_stale += 1
+            else:
+                refused_other += 1
+                reason = why
+        reply = {"ok": True, "joined": joined,
+                 "refused_stale": refused_stale,
+                 "refused": refused_stale + refused_other}
+        if reason is not None:
+            reply["reason"] = reason
+        return reply
+
     def handle_request(self, action, msg: dict, ver: int,
                        conn: socket.socket):
         """Serve protocol body on the shared frame (``hello``/``stop``/
@@ -159,4 +234,8 @@ class ServeServer(FrameServer):
         if action == "drain":
             drained = self.engine.drain(timeout=msg.get("timeout_s"))
             return {"ok": True, "drained": drained}
+        if action == "kv_fetch":
+            return self._handle_kv_fetch(msg, ver, conn)
+        if action == "kv_push":
+            return self._handle_kv_push(msg)
         return None

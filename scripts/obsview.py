@@ -579,6 +579,9 @@ def summarize_snapshot(doc: dict) -> str:
         sections.append([f"== {name} registry =="] + _instrument_lines(snap))
         sections.append(_codec_lines(snap))
         sections.append(_stream_lines(snap))
+        if "serve.router.kv_replications" in snap:
+            # drop the leading blank: sections are already newline-joined
+            sections.append(_kvfabric_lines(snap)[1:])
     return "\n".join("\n".join(s) for s in sections if s)
 
 
@@ -709,6 +712,13 @@ _LOW_ACCEPT = 0.25
 #: means requests are landing on engines that don't hold their prefix
 _MISROUTE_RATE = 0.5
 
+#: spill-warm fraction below this (with spill traffic flowing) renders
+#: the COLD-SPILL alarm (ISSUE 16): a working KV fabric replicates a
+#: hot prefix to its spill target after the FIRST overflow, so repeat
+#: overflow should mostly land warm — a trailing fraction means
+#: transfers are failing, being budget-skipped, or arriving stale
+_COLD_SPILL = 0.5
+
 
 def _accel_lines(stats: dict) -> list:
     """The ISSUE 11 accelerator panel: prefix-cache hit rate + LRU
@@ -762,6 +772,48 @@ def _router_lines(stats: dict) -> list:
         f"{_v('serve.router.promotes'):,.0f}  (failed "
         f"{_v('serve.router.promote_failures'):,.0f}, rolled forward "
         f"{_v('serve.router.promote_rollforwards'):,.0f})")
+    return lines
+
+
+def _kvfabric_lines(stats: dict) -> list:
+    """The ISSUE 16 fleet-KV-fabric panel (rendered when the stats
+    carry ``serve.router.kv_*`` — a fabric-enabled ``ServeRouter``):
+    replication/migration trail, push bytes, stale refusals, and the
+    warm-vs-cold spill TTFT split with the COLD-SPILL alarm."""
+
+    def _v(name):
+        return _num(stats.get(name, {}).get("value"), 0)
+
+    lines = ["", "== KV fabric =="]
+    lines.append(
+        f"transfers: replications {_v('serve.router.kv_replications'):,.0f}"
+        f"  migrations {_v('serve.router.kv_migrations'):,.0f}  "
+        f"push bytes {_v('serve.router.kv_push_bytes'):,.0f}  "
+        f"refused stale {_v('serve.router.kv_refused_stale'):,.0f}  "
+        f"secondary hits "
+        f"{_v('serve.router.affinity_secondary_hits'):,.0f}")
+    warm = stats.get("serve.router.ttft_spill_warm_seconds") or {}
+    cold = stats.get("serve.router.ttft_spill_cold_seconds") or {}
+    n_warm = int(warm.get("count") or 0)
+    n_cold = int(cold.get("count") or 0)
+    for label, h, n in (("spill ttft warm", warm, n_warm),
+                        ("spill ttft cold", cold, n_cold)):
+        if not n:
+            lines.append(f"{label}: n=0")
+            continue
+        lines.append(
+            f"{label}: n={n}  mean "
+            f"{_fmt_seconds(h['sum'] / n)}  p50 "
+            f"{_fmt_seconds(snapshot_quantile(h, 0.5))}  p99 "
+            f"{_fmt_seconds(snapshot_quantile(h, 0.99))}")
+    if n_warm + n_cold:
+        frac = n_warm / (n_warm + n_cold)
+        lines.append(
+            f"spill warm fraction: {frac:.0%}"
+            + (f"  << COLD-SPILL (spill traffic is mostly cold-"
+               f"prefilling; KV replication is not landing — check "
+               f"kv_refused_stale / the kv_fabric_mb budget)"
+               if frac < _COLD_SPILL else ""))
     return lines
 
 
@@ -888,6 +940,8 @@ def summarize_serve(reply: dict) -> str:
     lines.extend(_accel_lines(stats))
     if "serve.router.requests" in stats:
         lines.extend(_router_lines(stats))
+        if "serve.router.kv_replications" in stats:
+            lines.extend(_kvfabric_lines(stats))
     engines = reply.get("engines")
     if engines:
         lines.extend(_engine_balance_lines(engines, stats))

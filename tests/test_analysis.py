@@ -615,6 +615,66 @@ def test_wire_seam_repo_wire_stack_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# kv-version-guard
+# ---------------------------------------------------------------------------
+
+def test_kv_version_guard_flags_insert_outside_seam():
+    found = lint("""
+        def sneak(cache, entry):
+            cache.insert_remote(entry)
+
+        def sneakier(engine, entry):
+            engine._prefix.insert_remote(entry)
+        """, rule="kv-version-guard")
+    assert len(found) == 2
+    assert all("kvfabric" in f.message and "version" in f.message
+               for f in found)
+
+
+def test_kv_version_guard_clean_through_the_seam():
+    # routing the insert through the fabric's guarded entry point is
+    # the sanctioned spelling everywhere else in the package
+    found = lint("""
+        from distkeras_tpu.serve.kvfabric import admit_remote_entry
+
+        def land(engine, entry, version):
+            return admit_remote_entry(engine, entry, version)
+        """, rule="kv-version-guard")
+    assert found == []
+
+
+def test_kv_version_guard_exempts_seam_and_honors_pragma():
+    from distkeras_tpu.analysis import analyze_source
+    from distkeras_tpu.analysis.rules import RULES_BY_ID as rules
+    src = textwrap.dedent("""
+        def admit_remote_entry(engine, entry, version):
+            engine._prefix.insert_remote(entry)
+        """)
+    report = analyze_source(
+        src, rel="distkeras_tpu/serve/kvfabric.py",
+        rules=[rules["kv-version-guard"]])
+    assert report.findings == []
+    # a non-PrefixCache receiver disables with the standard pragma
+    found = lint("""
+        def replay(journal, entry):
+            journal.insert_remote(entry)  # dklint: disable=kv-version-guard
+        """, rule="kv-version-guard")
+    assert found == []
+
+
+def test_kv_version_guard_repo_is_clean():
+    """ISSUE 16 gate: every remote-KV insert in the package goes through
+    the version-stamped ``serve/kvfabric.py`` seam — no code path can
+    land peer KV in a ``PrefixCache`` without the stale-checkpoint
+    refusal check."""
+    from distkeras_tpu.analysis import run_paths
+    from distkeras_tpu.analysis.rules import RULES_BY_ID as rules
+    report = run_paths([os.path.join(_ROOT, "distkeras_tpu")],
+                       rules=[rules["kv-version-guard"]])
+    assert report.findings == [], [f.location() for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
 # suppression: inline pragma + baseline round-trip
 # ---------------------------------------------------------------------------
 
