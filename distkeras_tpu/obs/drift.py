@@ -139,7 +139,12 @@ class _Thresholds:
     def for_metric(self, metric: str) -> dict:
         th = dict(self.base)
         names = (metric, metric.split("/", 1)[-1])
-        for pat in sorted(self.per_metric):  # deterministic layering
+        # authoring-order layering: a later entry in the config file
+        # overrides an earlier one, so specificity is expressed by
+        # writing broad patterns first (JSON object order is preserved).
+        # Lexical sorting could never let a part-scoped pattern like
+        # "scenario_*/serve.*" override an exact "serve.*" name.
+        for pat in self.per_metric:
             if any(fnmatch.fnmatch(n, pat) for n in names):
                 th.update(self.per_metric[pat])
         return th

@@ -208,6 +208,17 @@ class ServeClient:
             msg["engine"] = str(engine)
         return self._rpc(msg)
 
+    def undrain(self, engine: Optional[str] = None) -> dict:
+        """Reopen admission on a parked (drained-but-running) service —
+        the scale-UP seam (ISSUE 17), the inverse of single-engine
+        ``drain``.  Against a ``ServeRouter``, ``engine="host:port"``
+        names the parked backend to un-drain and re-adopt into
+        rotation; against an engine server it un-drains that engine."""
+        msg: dict = {"action": "undrain"}
+        if engine is not None:
+            msg["engine"] = str(engine)
+        return self._rpc(msg)
+
     def close(self) -> None:
         try:
             send_msg(self.sock, {"action": "stop"}, registry=self.registry,

@@ -672,6 +672,26 @@ class DecodeEngine:
             else float(timeout)
         return self._idle_evt.wait(timeout)
 
+    def undrain(self) -> bool:
+        """Re-open admission on a drained-but-running engine — the
+        scale-UP primitive (ISSUE 17).  A drained engine keeps its
+        decode thread, warm-compiled functions, and KV cache parked;
+        un-draining it costs one flag flip, not a recompile — which is
+        what lets the autoscaler's fleet keep ``jit.retraces == 0``
+        across its whole scaling history.  Raises ``RuntimeError`` on a
+        STOPPED engine (its decode thread is gone; only ``start`` on a
+        fresh engine can serve again)."""
+        if self._stop_evt.is_set() or (
+                self._thread is not None and not self._thread.is_alive()):
+            raise RuntimeError("cannot undrain a stopped engine")
+        with self._lock:
+            was = self._draining
+            self._draining = False
+            self._work.notify_all()
+        if was:
+            get_logger(_LOG).info("engine un-drained: admission reopened")
+        return was
+
     def _abort_outstanding(self, reason: str) -> None:
         """Fail every request still queued or in a slot (post-stop): each
         is recorded under ``serve.rejected`` — the no-silent-drop

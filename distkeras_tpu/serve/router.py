@@ -1009,6 +1009,59 @@ class ServeRouter(FrameServer):
                 results[be.addr] = {"ok": False, "error": str(e)}
         return {"ok": True, "engines": results}
 
+    # -- autoscaler seam (ISSUE 17) -----------------------------------------
+    def scale_down(self, addr: str,
+                   timeout_s: Optional[float] = None) -> dict:
+        """Take one engine out of rotation — an alias for the planned
+        single-engine drain (migrate hot KV to survivors → drain →
+        evict).  The drained engine PARKS: its server keeps answering
+        stats (refusing rejoin while draining) with the warm-compiled
+        model intact, so :meth:`scale_up` can re-admit it without a
+        recompile."""
+        return self._drain_engine(str(addr), timeout_s)
+
+    def scale_up(self, addr: str) -> dict:
+        """Re-admit a parked engine: send ``undrain`` to reopen its
+        admission, then probe stats and re-adopt it through the SAME
+        rejoin path a recovered engine takes (synchronously — the
+        autoscaler must not wait a poller tick for capacity it just
+        asked for).  Roll-forward runs too, so an engine parked across
+        a promote rejoins on the fleet's current version."""
+        be = next((b for b in self.backends if b.addr == addr), None)
+        if be is None:
+            return {"ok": False, "error": f"unknown engine {addr!r}"}
+        with self._lock:
+            if be.alive:
+                return {"ok": True, "engine": be.addr,
+                        "already_alive": True}
+        try:
+            client = self._acquire(be)
+            try:
+                result = client.undrain()
+                reply = client.stats(retry=False)
+            except BaseException:
+                client.close()
+                raise
+            be.release(client)
+        except (ConnectionError, OSError, socket.timeout) as e:
+            return {"ok": False, "engine": be.addr, "error": str(e)}
+        if not result.get("ok"):
+            return {"ok": False, "engine": be.addr,
+                    "error": result.get("error", "undrain refused")}
+        self._adopt_stats(be, reply)
+        self._rollforward(be)
+        with self._lock:
+            alive = be.alive
+        return {"ok": alive, "engine": be.addr,
+                "was_draining": bool(result.get("was_draining"))}
+
+    def _handle_undrain(self, msg: dict) -> dict:
+        addr = msg.get("engine")
+        if addr is None:
+            return {"ok": False,
+                    "error": "router undrain needs an engine address"}
+        return self.scale_up(str(addr))
+
     # -- FrameServer plumbing -----------------------------------------------
     def handle_request(self, action, msg: dict, ver: int,
                        conn: socket.socket):
@@ -1020,6 +1073,8 @@ class ServeRouter(FrameServer):
             return self._handle_promote(msg)
         if action == "drain":
             return self._handle_drain(msg)
+        if action == "undrain":
+            return self._handle_undrain(msg)
         return None
 
     def _on_start(self) -> None:

@@ -234,6 +234,14 @@ class ServeServer(FrameServer):
         if action == "drain":
             drained = self.engine.drain(timeout=msg.get("timeout_s"))
             return {"ok": True, "drained": drained}
+        if action == "undrain":
+            # scale-up seam (ISSUE 17): reopen admission on a parked
+            # (drained-but-running) engine
+            try:
+                was = self.engine.undrain()
+            except RuntimeError as e:
+                return {"ok": False, "error": str(e)}
+            return {"ok": True, "was_draining": was}
         if action == "kv_fetch":
             return self._handle_kv_fetch(msg, ver, conn)
         if action == "kv_push":

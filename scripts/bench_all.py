@@ -1,20 +1,62 @@
-"""Benchmark harness: the five BASELINE.json configs, one table.
+"""Benchmark harness: the five BASELINE.json configs, one table —
+plus the scenario-harness smoke (ISSUE 17).
 
 Usage: ``python scripts/bench_all.py [--quick]``.
 
-The configs live as DATA in ``configs/bench_all.yaml`` (SURVEY.md §5.6:
-one checked-in file reproduces the whole table); this script is a thin
-alias for ``python -m distkeras_tpu.config configs/bench_all.yaml``.
+The trainer configs live as DATA in ``configs/bench_all.yaml``
+(SURVEY.md §5.6: one checked-in file reproduces the whole table); that
+part is a thin alias for ``python -m distkeras_tpu.config
+configs/bench_all.yaml``.  The scenario smoke is a subprocess running
+``bench.py --scenario smoke`` — the yaml schema is trainer-only, and
+the smoke wants the same one-JSON-row contract ``bench.py`` already
+keeps — appended so the nightly table also proves the open-loop serve
+path end to end.  ``--job`` (a packaging mode) skips it.
 """
 
+import json
 import os
+import subprocess
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 from distkeras_tpu import config  # noqa: E402
+from distkeras_tpu.obs.logging import emit  # noqa: E402
+
+
+def run_scenario_smoke() -> int:
+    """``bench.py --scenario smoke`` in a subprocess (its fleet binds
+    sockets and warms a serving model — keep the trainer process
+    clean); renders the row's headline as one more table-ish line."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"),
+         "--scenario", "smoke"],
+        capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        emit(f"scenario smoke FAILED (rc={proc.returncode}):\n"
+             f"{proc.stderr.strip()[-2000:]}", err=True)
+        return proc.returncode
+    try:
+        row = json.loads(proc.stdout)
+        s = row["scenarios"]["smoke"]
+    except (ValueError, KeyError) as e:
+        emit(f"scenario smoke: unparseable bench row ({e})", err=True)
+        return 1
+    counts = s.get("counts", {})
+    emit(f"| scenario smoke | {counts.get('dispatched', 0)} dispatched "
+         f"({counts.get('completed', 0)} ok, "
+         f"{counts.get('rejected', 0)} shed, "
+         f"{counts.get('timeouts', 0)} timeout) "
+         f"| attainment_ok {row.get('attainment_ok')} "
+         f"| retraces {row.get('jit_retraces')} "
+         f"| {s.get('wall_s', 0):.1f}s |")
+    return 0
+
 
 if __name__ == "__main__":
-    sys.exit(config.main(
-        [os.path.join(ROOT, "configs", "bench_all.yaml"), *sys.argv[1:]]))
+    rc = config.main(
+        [os.path.join(ROOT, "configs", "bench_all.yaml"), *sys.argv[1:]])
+    if rc == 0 and "--job" not in sys.argv[1:]:
+        rc = run_scenario_smoke()
+    sys.exit(rc)

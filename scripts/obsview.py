@@ -1081,6 +1081,137 @@ def run_continual(target: str) -> int:
     return 0
 
 
+def summarize_scenario(doc: dict, source: str) -> str:
+    """Scenario-harness panel (ISSUE 17) over a persisted
+    ``BENCH_SCENARIO_OBS.json``: one per-phase SLO table + scale-event
+    trail per scenario, the open-loop accounting identity, and the
+    SLO-MISS alarm for any phase whose attainment landed under the
+    committed target."""
+    row = doc.get("row", {})
+    slo = row.get("slo", {})
+    target = float(slo.get("attainment", 0.95) or 0.95)
+    lines = [f"== Scenario harness ({source}) ==",
+             f"SLO: ttft<={slo.get('ttft_s', '?')}s  "
+             f"e2e<={slo.get('e2e_s', '?')}s  "
+             f"target attainment {target:.2f}"]
+    misses = []
+    for name, s in (row.get("scenarios") or {}).items():
+        counts = s.get("counts", {})
+        lines += ["",
+                  f"-- {name} (seed {s.get('seed', '?')}, "
+                  f"{s.get('arrivals', '?')} arrivals, "
+                  f"{s.get('wall_s', 0):.1f}s wall, "
+                  f"{s.get('engines', '?')} engines) --",
+                  f"{'phase':<12} {'offered':>8} {'done':>7} {'shed%':>6} "
+                  f"{'attain':>7}  {'goodput':>9}  {'ttft p99':>9}  "
+                  f"{'e2e p99':>9}"]
+        for p in s.get("phases", []):
+            att = p.get("attainment")
+            miss = att is not None and att < target
+            if miss:
+                misses.append(f"{name}/{p['phase']}")
+            lines.append(
+                f"{p.get('phase', '?'):<12} {p.get('offered', 0):>8} "
+                f"{p.get('completed', 0):>7} "
+                f"{p.get('shed_rate', 0) * 100:>5.1f}% "
+                f"{'n/a' if att is None else f'{att:.3f}':>7}  "
+                f"{p.get('goodput_tps', 0):>7.1f}/s  "
+                f"{_fmt_seconds(_num(p.get('ttft_p99'), 0.0)):>9}  "
+                f"{_fmt_seconds(_num(p.get('e2e_p99'), 0.0)):>9}"
+                + ("  << SLO-MISS" if miss else ""))
+        settled = (counts.get("completed", 0) + counts.get("rejected", 0)
+                   + counts.get("timeouts", 0))
+        lines.append(
+            f"open loop: dispatched {counts.get('dispatched', 0)} = "
+            f"completed {counts.get('completed', 0)} + rejected "
+            f"{counts.get('rejected', 0)} + timeouts "
+            f"{counts.get('timeouts', 0)}"
+            + ("" if counts.get("dispatched", 0) == settled
+               else "  << ACCOUNTING LEAK"))
+        if s.get("recovery_s_p50") is not None:
+            lines.append(f"recovery p50: "
+                         f"{_fmt_seconds(s['recovery_s_p50'])} "
+                         f"(engines alive at end: "
+                         f"{s.get('engines_alive_end', '?')})")
+        events = s.get("scale_events") or []
+        if events:
+            lines.append(f"scale events ({s.get('scale_up', 0)} up / "
+                         f"{s.get('scale_down', 0)} down):")
+            for e in events:
+                lines.append(
+                    f"  t={e.get('t', 0):>7.3f}s  "
+                    f"{e.get('action', '?'):<5} -> "
+                    f"{e.get('alive', '?')} alive  "
+                    f"[{e.get('engine', '?')}]  {e.get('reason', '')}"
+                    + ("" if e.get("ok") else "  FAILED"))
+    lines += ["", "== Verdicts =="]
+    lines.append("SLO-MISS phases: " + (", ".join(misses) if misses
+                                        else "none")
+                 + ("  << SLO-MISS" if misses else ""))
+    lines.append(
+        f"attainment_ok: {row.get('attainment_ok', '?')}   "
+        f"autoscaler_tracked: {row.get('autoscaler_tracked', '?')}   "
+        f"jit_retraces: {row.get('jit_retraces', '?')}")
+    return "\n".join(lines)
+
+
+def summarize_scenario_live(reply: dict, target: str) -> str:
+    """Live ``--scenario HOST:PORT`` view: the signals an
+    :class:`~distkeras_tpu.scenario.AutoScaler` folds each tick —
+    cumulative SLO attainment straight from the merged serve
+    histograms, fleet queue pressure, and any ``scenario.*`` counters
+    a co-resident harness publishes — over the SAME merged-stats poll
+    ``--serve`` uses."""
+    from distkeras_tpu.scenario import SLOTarget, hist_fraction_le
+    stats = reply.get("stats", {})
+    slo = SLOTarget()
+    fr_ttft = hist_fraction_le(stats.get("serve.ttft_seconds"), slo.ttft_s)
+    fr_e2e = hist_fraction_le(stats.get("serve.e2e_seconds"), slo.e2e_s)
+    cands = [f for f in (fr_ttft, fr_e2e) if f is not None]
+    att = min(cands) if cands else None
+    alive = reply.get("engines_alive", reply.get("num_engines", 1)) or 1
+    qd = _num(reply.get("queue_depth"), 0.0)
+    miss = att is not None and att < slo.attainment
+    lines = [f"== Scenario signals (live {target}) ==",
+             f"SLO: ttft<={slo.ttft_s}s  e2e<={slo.e2e_s}s  "
+             f"target attainment {slo.attainment:.2f}",
+             f"attainment (cumulative): "
+             f"{'n/a (no traffic)' if att is None else f'{att:.3f}'}"
+             + ("  << SLO-MISS" if miss else ""),
+             f"engines alive: {alive}   fleet queue: {qd:.0f}   "
+             f"queue/engine: {qd / max(int(alive), 1):.1f}   "
+             f"active slots: {reply.get('active_slots', '?')}"]
+    scen = {k: v.get("value", 0) for k, v in stats.items()
+            if k.startswith("scenario.") and "value" in v}
+    if scen:
+        lines += ["", "== Scenario counters =="]
+        for k in sorted(scen):
+            lines.append(f"{k:<32} {scen[k]:>10,.0f}")
+    return "\n".join(lines)
+
+
+def run_scenario(target: str) -> int:
+    """``--scenario`` body: live HOST:PORT (a ``ServeRouter`` or engine
+    stats RPC) or a persisted ``BENCH_SCENARIO_OBS.json``."""
+    host, _, port = target.rpartition(":")
+    if host and port.isdigit():
+        reply = poll_serve(host, int(port))
+        emit(summarize_scenario_live(reply, target))
+        return 0
+    try:
+        doc = load_snapshot(target)
+    except OSError as e:
+        emit(f"obsview --scenario: cannot read {target}: {e}", err=True)
+        return 2
+    if doc is None or "scenarios" not in (doc.get("row") or {}):
+        emit(f"obsview --scenario: {target} is neither HOST:PORT nor a "
+             "scenario-bench snapshot (expected a row.scenarios table)",
+             err=True)
+        return 2
+    emit(summarize_scenario(doc, os.path.basename(target)))
+    return 0
+
+
 def run_diff(base: str, cand: str, thresholds=None) -> int:
     """``--diff`` body: drift-gate two snapshot files.  Exit codes are the
     CI contract — 0 clean, 1 drift, 2 unreadable/invalid input."""
@@ -1145,6 +1276,14 @@ def main(argv=None) -> int:
                          "persisted BENCH_CONTINUAL_OBS.json (window "
                          "verdicts, deploy history, stream lag, "
                          "DRIFT-DIRTY/RETRACING alarms)")
+    ap.add_argument("--scenario", metavar="TARGET",
+                    help="scenario-harness view (ISSUE 17): a file path "
+                         "reads a persisted BENCH_SCENARIO_OBS.json "
+                         "(per-phase SLO table, scale-event trail, "
+                         "SLO-MISS alarm); HOST:PORT polls a live "
+                         "decode service and renders the autoscaler's "
+                         "signal view over the same merged-stats path "
+                         "as --serve")
     ap.add_argument("--diff", nargs=2, metavar=("BASE", "CAND"),
                     help="compare two registry-snapshot files for "
                          "distribution drift (exit 0 clean / 1 drift / "
@@ -1164,9 +1303,9 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if sum(map(bool, (args.jsonl, args.ps, args.serve, args.continual,
-                      args.diff))) != 1:
-        ap.error("need exactly one of JSONL, --ps, --serve, --continual "
-                 "or --diff")
+                      args.scenario, args.diff))) != 1:
+        ap.error("need exactly one of JSONL, --ps, --serve, --continual, "
+                 "--scenario or --diff")
     if args.export_trace and not args.jsonl:
         ap.error("--export-trace needs a JSONL metrics file")
 
@@ -1175,6 +1314,9 @@ def main(argv=None) -> int:
 
     if args.continual:
         return run_continual(args.continual)
+
+    if args.scenario:
+        return run_scenario(args.scenario)
 
     if args.ps:
         try:

@@ -174,6 +174,7 @@ def test_space_to_depth_layout_and_grads():
     np.testing.assert_allclose(np.asarray(g), 2 * np.asarray(x))
 
 
+@pytest.mark.slow
 def test_resnet50_s2d_stem_trains():
     """zoo.resnet50(stem='s2d'): same output surface as the conv7 stem,
     serde roundtrip included, and a few SGD steps reduce the loss."""

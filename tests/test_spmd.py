@@ -158,6 +158,7 @@ def test_infer_param_specs_conv_kernels_channel_only():
     assert specs["conv_in"] == P(None, None, "mp", None)
 
 
+@pytest.mark.slow
 def test_spmd_trainer_mp_on_conv_model():
     """SpmdTrainer mp on a real conv model (zoo.resnet20): channel-dim
     sharding must actually shrink per-device bytes and the compiled HLO
