@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 from typing import List, Optional
 
@@ -40,6 +41,29 @@ def _discover_baseline(paths: List[str]) -> Optional[str]:
             parent = os.path.dirname(anchor)
             anchor = core.find_anchor(parent) if parent != anchor else None
     return None
+
+
+def _changed_files(base: str, paths: List[str]) -> Optional[List[str]]:
+    """``git diff --name-only <base>`` filtered to Python files that
+    still exist AND fall under one of the requested ``paths`` — the
+    fast pre-commit loop (``--changed``) shares every other flag with
+    the repo-wide gate.  None on git failure (caller reports usage
+    error)."""
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", "-z", base, "--"],
+            capture_output=True, check=True)
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    roots = [os.path.abspath(p) for p in paths]
+    picked = []
+    for name in out.stdout.decode("utf-8", "replace").split("\0"):
+        if not name.endswith(".py") or not os.path.exists(name):
+            continue
+        full = os.path.abspath(name)
+        if any(full == r or full.startswith(r + os.sep) for r in roots):
+            picked.append(name)
+    return picked
 
 
 def _select_rules(spec: Optional[str]) -> List[core.Rule]:
@@ -73,6 +97,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="accept the current findings as the baseline "
                          "and write them to the baseline file")
+    ap.add_argument("--changed", nargs="?", const="HEAD", metavar="REF",
+                    help="lint only files changed vs REF (git diff "
+                         "--name-only; default HEAD), intersected with "
+                         "the given paths")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="parse and per-file-check N files in parallel "
+                         "(interprocedural rules still run once, over "
+                         "the whole set)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -91,6 +123,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     if baseline_path is None:
         baseline_path = _discover_baseline(args.paths)
 
+    scan_paths = list(args.paths)
+    if args.changed is not None:
+        changed = _changed_files(args.changed, scan_paths)
+        if changed is None:
+            emit(f"dklint: git diff against {args.changed!r} failed "
+                 f"(not a git checkout, or unknown ref)", err=True)
+            return 2
+        if not changed:
+            emit("dklint: no changed Python files under the given paths")
+            return 0
+        scan_paths = changed
+
     write_target = None
     bootstrap = None
     if args.write_baseline and args.rules:
@@ -98,6 +142,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         # findings, silently dropping every other rule's accepted debt
         emit("dklint: --write-baseline requires the full rule set "
              "(drop --rules)", err=True)
+        return 2
+    if args.write_baseline and args.changed is not None:
+        # same trap, file axis: a changed-only scan would overwrite the
+        # baseline with only the changed files' findings
+        emit("dklint: --write-baseline requires a full scan "
+             "(drop --changed)", err=True)
         return 2
     if args.write_baseline:
         write_target = args.baseline or baseline_path or _DEFAULT_BASELINE
@@ -108,7 +158,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             core.write_baseline(write_target, [])
             bootstrap = write_target
 
-    report = core.run_paths(args.paths, rules=rules)
+    report = core.run_paths(scan_paths, rules=rules,
+                            jobs=max(1, args.jobs))
     if report.errors:
         if bootstrap is not None:
             # don't leave a stray empty baseline behind on a failed run —

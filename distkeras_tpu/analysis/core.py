@@ -175,26 +175,62 @@ def iter_py_files(path: str) -> Iterable[Tuple[str, str]]:
                                             base).replace(os.sep, "/")
 
 
+def _split_rules(rules: Optional[Sequence[Rule]]
+                 ) -> Tuple[List[Rule], List[Rule]]:
+    """(per-file rules, project rules).  Project rules (``project=True``,
+    see ``rules_project.ProjectRule``) run ONCE over the whole scan's
+    graph instead of per file."""
+    from .rules import ALL_RULES
+    all_rules = list(rules if rules is not None else ALL_RULES)
+    file_rules = [r for r in all_rules if not getattr(r, "project", False)]
+    project_rules = [r for r in all_rules if getattr(r, "project", False)]
+    return file_rules, project_rules
+
+
+def _check_project(contexts: Sequence["FileContext"],
+                   project_rules: Sequence[Rule],
+                   report: "Report") -> None:
+    """Run the interprocedural rules over the graph of every parsed
+    file; inline ``# dklint: disable`` pragmas still apply, keyed by the
+    file each finding anchors in (findings in non-Python files — e.g.
+    OBS_BASELINE.json — have no pragma channel and pass through)."""
+    if not project_rules or not contexts:
+        return
+    from .graph import build_graph
+    graph = build_graph(contexts)
+    ctx_by_rel = {c.rel: c for c in contexts}
+    for rule in project_rules:
+        for f in rule.check_project(graph):
+            ctx = ctx_by_rel.get(f.rel)
+            if ctx is not None and ctx.disabled(f.line, f.rule):
+                report.inline_suppressed.append(f)
+            else:
+                report.findings.append(f)
+
+
 def analyze_source(source: str, path: str = "<string>",
                    rel: Optional[str] = None,
                    rules: Optional[Sequence[Rule]] = None,
                    _finalize: bool = True) -> Report:
     """Run ``rules`` over one source string; inline pragmas applied.
-    ``_finalize=False`` skips the sort + fingerprint pass (``run_paths``
-    does both once over the aggregate instead)."""
-    from .rules import ALL_RULES
+    Project rules see a single-file graph (fixture tests exercise the
+    interprocedural rules through the same door).  ``_finalize=False``
+    skips the sort + fingerprint pass (``run_paths`` does both once over
+    the aggregate instead)."""
+    file_rules, project_rules = _split_rules(rules)
     report = Report()
     try:
         ctx = FileContext(path, source, rel=rel)
     except SyntaxError as e:
         report.errors.append((path, f"syntax error: {e}"))
         return report
-    for rule in (rules if rules is not None else ALL_RULES):
+    for rule in file_rules:
         for f in rule.check(ctx):
             if ctx.disabled(f.line, f.rule):
                 report.inline_suppressed.append(f)
             else:
                 report.findings.append(f)
+    _check_project([ctx], project_rules, report)
     if _finalize:
         report.findings.sort(key=lambda f: (f.rel, f.line, f.col, f.rule))
         _assign_fingerprints(report.findings)
@@ -202,26 +238,56 @@ def analyze_source(source: str, path: str = "<string>",
 
 
 def run_paths(paths: Sequence[str],
-              rules: Optional[Sequence[Rule]] = None) -> Report:
+              rules: Optional[Sequence[Rule]] = None,
+              jobs: int = 1) -> Report:
     """Run ``rules`` over files/directories; findings carry fingerprints
-    relative to each scan root so the baseline survives repo moves."""
+    relative to each scan root so the baseline survives repo moves.
+    ``jobs > 1`` parses and per-file-checks files on a thread pool (the
+    interprocedural pass still runs once, over every parsed file);
+    output is deterministic either way — merge order is the sorted walk
+    order, not completion order."""
+    file_rules, project_rules = _split_rules(rules)
     report = Report()
+    work: List[Tuple[str, str]] = []
     for root in paths:
         if not os.path.exists(root):
             report.errors.append((root, "no such file or directory"))
             continue
-        for full, rel in iter_py_files(root):
-            try:
-                with open(full, encoding="utf-8") as f:
-                    source = f.read()
-            except OSError as e:
-                report.errors.append((full, f"unreadable: {e}"))
-                continue
-            sub = analyze_source(source, path=full, rel=rel, rules=rules,
-                                 _finalize=False)
-            report.findings.extend(sub.findings)
-            report.inline_suppressed.extend(sub.inline_suppressed)
-            report.errors.extend(sub.errors)
+        work.extend(iter_py_files(root))
+
+    def _one(item: Tuple[str, str]):
+        full, rel = item
+        try:
+            with open(full, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            return None, [(full, f"unreadable: {e}")], [], []
+        try:
+            ctx = FileContext(full, source, rel=rel)
+        except SyntaxError as e:
+            return None, [(full, f"syntax error: {e}")], [], []
+        found, suppressed = [], []
+        for rule in file_rules:
+            for f in rule.check(ctx):
+                (suppressed if ctx.disabled(f.line, f.rule)
+                 else found).append(f)
+        return ctx, [], found, suppressed
+
+    if jobs > 1 and len(work) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=jobs) as pool:
+            results = list(pool.map(_one, work))
+    else:
+        results = [_one(item) for item in work]
+
+    contexts: List[FileContext] = []
+    for ctx, errors, found, suppressed in results:
+        if ctx is not None:
+            contexts.append(ctx)
+        report.errors.extend(errors)
+        report.findings.extend(found)
+        report.inline_suppressed.extend(suppressed)
+    _check_project(contexts, project_rules, report)
     report.findings.sort(key=lambda f: (f.rel, f.line, f.col, f.rule))
     _assign_fingerprints(report.findings)
     return report

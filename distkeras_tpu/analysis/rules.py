@@ -846,6 +846,8 @@ class KvVersionGuardRule(Rule):
         ]
 
 
+from .rules_project import PROJECT_RULES  # noqa: E402  (needs Rule above)
+
 ALL_RULES: Tuple[Rule, ...] = (
     JitPurityRule(),
     LockDisciplineRule(),
@@ -856,6 +858,6 @@ ALL_RULES: Tuple[Rule, ...] = (
     ShmLifecycleRule(),
     WireSeamRule(),
     KvVersionGuardRule(),
-)
+) + PROJECT_RULES
 
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}

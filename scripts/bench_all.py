@@ -1,5 +1,6 @@
 """Benchmark harness: the five BASELINE.json configs, one table —
-plus the scenario-harness smoke (ISSUE 17).
+plus the scenario-harness smoke (ISSUE 17) and the dklint gate
+(ISSUE 18).
 
 Usage: ``python scripts/bench_all.py [--quick]``.
 
@@ -10,13 +11,18 @@ configs/bench_all.yaml``.  The scenario smoke is a subprocess running
 ``bench.py --scenario smoke`` — the yaml schema is trainer-only, and
 the smoke wants the same one-JSON-row contract ``bench.py`` already
 keeps — appended so the nightly table also proves the open-loop serve
-path end to end.  ``--job`` (a packaging mode) skips it.
+path end to end.  The dklint gate runs ``dklint --format json``
+repo-wide and fails the nightly on findings or IO errors, and
+round-trips the committed ``dklint_baseline.json`` in the same run so
+serializer drift surfaces the night it lands.  ``--job`` (a packaging
+mode) skips both.
 """
 
 import json
 import os
 import subprocess
 import sys
+import tempfile
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
@@ -54,9 +60,68 @@ def run_scenario_smoke() -> int:
     return 0
 
 
+def _baseline_round_trip(path: str) -> int:
+    """load -> write(tmp) -> reload the committed baseline and compare
+    fingerprint sets: any writer/loader asymmetry would silently grow or
+    shed accepted debt on the next ``--write-baseline``."""
+    from distkeras_tpu.analysis import core as lint_core
+    try:
+        with open(path, encoding="utf-8") as f:
+            entries = json.load(f)["findings"]
+        fps = lint_core.load_baseline(path)
+    except (OSError, ValueError, KeyError) as e:
+        emit(f"dklint baseline: unreadable {path} ({e})", err=True)
+        return 1
+    findings = [
+        lint_core.Finding(rule=e["rule"], path=e["path"], rel=e["path"],
+                          line=0, col=0, message=e["message"],
+                          snippet=e.get("snippet", ""),
+                          fingerprint=e["fingerprint"])
+        for e in entries]
+    fd, tmp = tempfile.mkstemp(suffix=".json")
+    os.close(fd)
+    try:
+        lint_core.write_baseline(tmp, findings)
+        if lint_core.load_baseline(tmp) != fps:
+            emit("dklint baseline: round-trip mismatch — load -> write -> "
+                 "reload changed the fingerprint set", err=True)
+            return 1
+    finally:
+        os.unlink(tmp)
+    return 0
+
+
+def run_dklint_gate() -> int:
+    """Repo-wide ``dklint --format json`` in a subprocess (same
+    invocation a contributor would run); exit 1 (findings) or 2 (IO /
+    usage) fails the nightly.  The baseline round-trip rides in the
+    same run."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "dklint.py"),
+         "--format", "json"],
+        capture_output=True, text=True, cwd=ROOT, timeout=600)
+    if proc.returncode != 0:
+        emit(f"dklint gate FAILED (rc={proc.returncode}):\n"
+             f"{(proc.stdout + proc.stderr).strip()[-2000:]}", err=True)
+        return proc.returncode
+    try:
+        doc = json.loads(proc.stdout)
+        n = len(doc["findings"])
+        supp = doc["suppressed"]
+    except (ValueError, KeyError, TypeError) as e:
+        emit(f"dklint gate: unparseable report ({e})", err=True)
+        return 1
+    emit(f"| dklint | {n} finding(s) "
+         f"| {supp.get('inline', 0)} inline "
+         f"+ {supp.get('baseline', 0)} baseline suppressed |")
+    return _baseline_round_trip(os.path.join(ROOT, "dklint_baseline.json"))
+
+
 if __name__ == "__main__":
     rc = config.main(
         [os.path.join(ROOT, "configs", "bench_all.yaml"), *sys.argv[1:]])
     if rc == 0 and "--job" not in sys.argv[1:]:
         rc = run_scenario_smoke()
+    if rc == 0 and "--job" not in sys.argv[1:]:
+        rc = run_dklint_gate()
     sys.exit(rc)

@@ -539,6 +539,47 @@ def summarize(records: list) -> str:
     return "\n".join("\n".join(s) for s in sections if s)
 
 
+def _host_lines(stats: dict) -> list:
+    """Host-side health: the input-pipeline counters
+    (``stream.batches`` / prefetch occupancy / the PR 7 producer-leak
+    tally) and the profiler's memory watermarks (``mem.*`` gauges).
+    Neither family had a panel before ISSUE 18 — a stalled producer or a
+    climbing live-bytes watermark was invisible unless someone read the
+    raw instrument table."""
+    batches = stats.get("stream.batches", {}).get("value", 0)
+    stall = stats.get("stream.stall_seconds")
+    live = stats.get("mem.live_bytes", {}).get("value")
+    if not batches and not (stall and stall.get("count")) \
+            and live is None:
+        return []
+    lines = ["== Host (input pipeline / memory) =="]
+    if batches or (stall and stall.get("count")):
+        line = f"batches: {batches:,.0f}"
+        occ = stats.get("stream.prefetch_occupancy", {}).get("value")
+        if occ is not None:
+            line += f"   prefetch occupancy: {_num(occ, 0.0):.1f}"
+        if stall and stall.get("count"):
+            line += (f"   stalls: n={stall['count']} p99 "
+                     f"{_fmt_seconds(snapshot_quantile(stall, 0.99))}")
+        leaks = stats.get("stream.producer_leaks", {}).get("value", 0)
+        if leaks:
+            line += f"   PRODUCER LEAKS: {leaks:,.0f}"
+        lines.append(line)
+    if live is not None:
+        mb = 1024.0 * 1024.0
+        line = (f"host live: {_num(live, 0.0) / mb:,.1f} MiB "
+                f"({stats.get('mem.live_arrays', {}).get('value', 0):,.0f} "
+                f"arrays)")
+        peak = stats.get("mem.peak_live_bytes", {}).get("value")
+        if peak is not None:
+            line += f"   peak: {_num(peak, 0.0) / mb:,.1f} MiB"
+        dev = stats.get("mem.device_peak_bytes", {}).get("value")
+        if dev is not None:
+            line += f"   device peak: {_num(dev, 0.0) / mb:,.1f} MiB"
+        lines.append(line)
+    return lines
+
+
 def _instrument_lines(stats: dict) -> list:
     """One line per instrument in a registry snapshot."""
     lines = []
@@ -579,6 +620,7 @@ def summarize_snapshot(doc: dict) -> str:
         sections.append([f"== {name} registry =="] + _instrument_lines(snap))
         sections.append(_codec_lines(snap))
         sections.append(_stream_lines(snap))
+        sections.append(_host_lines(snap))
         if "serve.router.kv_replications" in snap:
             # drop the leading blank: sections are already newline-joined
             sections.append(_kvfabric_lines(snap)[1:])
