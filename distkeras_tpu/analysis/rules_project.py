@@ -319,9 +319,9 @@ def _pattern_matches_site(pattern: str, site_name: str,
 class MetricContractRule(ProjectRule):
     id = "metric-contract"
     description = ("every metric name must agree across creation sites, "
-                   "OBS_BASELINE.json thresholds and obsview renderers; "
-                   "exactly-gated counters must be pre-created (0 is "
-                   "present, not missing)")
+                   "OBS_BASELINE.json thresholds, alert rules and obsview "
+                   "renderers; exactly-gated counters must be pre-created "
+                   "(0 is present, not missing)")
 
     #: sources scanned for creation sites IN ADDITION to the lint paths.
     #: The package itself is listed so a partial scan (``--changed``, a
@@ -348,6 +348,8 @@ class MetricContractRule(ProjectRule):
         findings: List[Finding] = []
         self._check_baseline(root, baseline_path, baseline,
                              baseline_lines, sites, findings)
+        self._check_alerts(root, baseline_path, baseline,
+                           baseline_lines, sites, findings)
         self._check_obsview(root, sites, findings)
         self._check_precreated(baseline, sites, findings)
         return findings
@@ -364,8 +366,16 @@ class MetricContractRule(ProjectRule):
     def _creation_sites(self, graph: ProjectGraph,
                         root: str) -> Dict[str, List[_Site]]:
         """metric/span name (exact or ``*``-glob) -> creation sites,
-        collected from the scanned graph plus the aux sources."""
+        collected from the scanned graph plus the aux sources.
+
+        A creation call carrying ``labels={...}`` (ISSUE 20) registers
+        as the glob ``<name>.*`` — the instrument's FLAT name appends
+        sorted ``<key><value>`` parts, so baseline patterns and obsview
+        reads against the flattened family keep matching.  The literal
+        label keys seen per base name land in ``self._labels_at`` for
+        the alert-rule typo check."""
         sites: Dict[str, List[_Site]] = {}
+        self._labels_at: Dict[str, Set[str]] = {}
         trees: List[Tuple[str, ast.AST]] = [
             (ctx.rel, ctx.tree) for ctx in graph.contexts]
         scanned = {c.rel for c in graph.contexts}
@@ -413,10 +423,39 @@ class MetricContractRule(ProjectRule):
                 if name is None or not _METRIC_NAME.match(
                         name.replace("*", "x")):
                     continue
+                label_keys = self._label_keys(node)
+                if label_keys is not None:
+                    # labeled instrument: only flattened names exist at
+                    # runtime — register the family glob, not the base
+                    self._labels_at.setdefault(name, set()).update(
+                        label_keys)
+                    sites.setdefault(name + ".*", []).append(_Site(
+                        rel, node.lineno, "", id(node) in chained_ids,
+                        True, kind))
+                    continue
                 sites.setdefault(name, []).append(_Site(
                     rel, node.lineno, "", id(node) in chained_ids,
                     "*" in name, kind))
         return sites
+
+    @staticmethod
+    def _label_keys(node: ast.Call) -> Optional[Set[str]]:
+        """Literal label keys of a creation call's ``labels={...}``
+        keyword; ``None`` when the call is unlabeled (no kwarg, or a
+        literal ``labels=None``)."""
+        for kw in node.keywords:
+            if kw.arg != "labels":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and v.value is None:
+                return None
+            keys: Set[str] = set()
+            if isinstance(v, ast.Dict):
+                keys = {k.value for k in v.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)}
+            return keys  # non-literal dicts: labeled, keys unknown
+        return None
 
     @staticmethod
     def _chained_creations(tree: ast.AST) -> Set[int]:
@@ -493,6 +532,49 @@ class MetricContractRule(ProjectRule):
                     rel, baseline_lines, f'"{fname}"',
                     f"snapshot file '{fname}' (mode '{mode}') does not "
                     f"exist — the drift gate for that bench is vacuous"))
+
+    def _check_alerts(self, root, baseline_path, baseline,
+                      baseline_lines, sites, findings) -> None:
+        """Alert rules are part of the metric contract (ISSUE 20): a
+        rule whose metric (flat or labeled) resolves to no creation site
+        can never fire — silently.  Structural problems (unknown keys,
+        label keys outside the shared vocabulary) surface through the
+        same strict parser the live engine uses, so lint and runtime
+        reject identical shapes."""
+        doc = baseline.get("alerts")
+        if not doc:
+            return
+        rel = os.path.relpath(baseline_path, root).replace(os.sep, "/")
+        try:
+            from ..obs.alerts import parse_rules
+        except ImportError:
+            return
+        try:
+            rules = parse_rules(doc)
+        except ValueError as e:
+            findings.append(self._file_finding(
+                rel, baseline_lines, '"alerts"',
+                f"malformed alert rules: {e}"))
+            return
+        labels_at = getattr(self, "_labels_at", {})
+        for rule in rules:
+            flat = rule.flat_metric()
+            if not self._matches_any(flat, sites):
+                findings.append(self._file_finding(
+                    rel, baseline_lines, f'"{rule.name}"',
+                    f"dead alert rule '{rule.name}': metric '{flat}' "
+                    f"matches no creation site anywhere in the repo — "
+                    f"it can never fire (renamed metric? label typo?)"))
+                continue
+            known = labels_at.get(rule.metric)
+            for k in (rule.labels or {}):
+                if known and k not in known:
+                    findings.append(self._file_finding(
+                        rel, baseline_lines, f'"{rule.name}"',
+                        f"alert rule '{rule.name}': label key '{k}' is "
+                        f"never used at a creation site of "
+                        f"'{rule.metric}' (sites label by "
+                        f"{sorted(known)}) — likely a typo"))
 
     def _check_obsview(self, root, sites, findings) -> None:
         path = os.path.join(root, "scripts", "obsview.py")

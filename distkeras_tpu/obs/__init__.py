@@ -17,6 +17,13 @@ deltas, bucket-wise PSI + quantile shift, thresholds from the committed
 ``OBS_BASELINE.json``) and ``stragglers`` turns per-window worker
 heartbeat gaps into a live ``ps.stragglers`` gauge.
 
+The telemetry plane (ISSUE 20): instruments take an optional
+``labels={...}`` dimension that flattens into the legacy dotted names
+(``registry.flat_name``), ``timeseries`` aggregates push-shipped
+``snapshot_delta`` increments into one bounded live fleet series, and
+``alerts`` evaluates threshold + SLO burn-rate rules over it with
+hysteresis — the live half of the drift gate's contract.
+
 The profiling layer (ISSUE 6): ``profile`` adds the recompilation
 sentinel (``jit.compiles``/``jit.retraces``, drift-gated), memory
 watermarks (``mem.*`` gauges sampled at the heartbeat points), the
@@ -35,6 +42,8 @@ from .registry import (  # noqa: F401
     Histogram,
     Registry,
     default_registry,
+    flat_name,
+    flatten_snapshot,
     snapshot_quantile,
 )
 from .spans import SpanTracer, default_tracer, set_default_sink, span  # noqa: F401
@@ -67,4 +76,11 @@ from .drift import (  # noqa: F401
     find_baseline,
     load_baseline,
     snapshot_delta,
+)
+from .timeseries import TelemetryShipper, TimeSeriesStore  # noqa: F401
+from .alerts import (  # noqa: F401
+    KNOWN_LABEL_KEYS,
+    AlertEngine,
+    AlertRule,
+    parse_rules,
 )

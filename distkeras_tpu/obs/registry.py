@@ -22,10 +22,52 @@ private registries so their snapshots describe exactly one component.
 from __future__ import annotations
 
 import bisect
+import re
 import threading
-from typing import Dict, Optional, Sequence, Union
+from typing import Dict, Mapping, Optional, Sequence, Union
 
 Number = Union[int, float]
+
+#: label keys are identifier-shaped; values concatenate into the flat
+#: name, so anything that would start a new ``.``-segment is rejected
+_LABEL_KEY = re.compile(r"^[a-z][a-z0-9_]*$")
+_LABEL_VALUE = re.compile(r"^[A-Za-z0-9_:-]+$")
+
+
+def flat_name(name: str, labels: Optional[Mapping[str, object]] = None
+              ) -> str:
+    """The back-compat flattening rule (ISSUE 20): a labeled instrument
+    lives in the registry under ``name + ".<key><value>"`` per label in
+    sorted key order — ``("ps.staleness", {"worker": 3})`` flattens to
+    ``"ps.staleness.worker3"``, exactly the name the pre-label
+    ``worker<k>`` families used, so OBS_BASELINE patterns, obsview
+    renderers and the dklint metric-contract gate keep matching
+    unchanged."""
+    if not labels:
+        return name
+    parts = []
+    for k in sorted(labels):
+        if not isinstance(k, str) or not _LABEL_KEY.match(k):
+            raise ValueError(
+                f"metric {name!r}: bad label key {k!r} (want "
+                f"[a-z][a-z0-9_]*)")
+        v = str(labels[k])
+        if not _LABEL_VALUE.match(v):
+            raise ValueError(
+                f"metric {name!r}: bad label value {v!r} for key {k!r} "
+                f"(no whitespace/dots — it embeds in the flat name)")
+        parts.append(f".{k}{v}")
+    return name + "".join(parts)
+
+
+def flatten_snapshot(snap: dict) -> dict:
+    """Strip label metadata from a (possibly labeled) snapshot, leaving
+    the plain flat-name form every pre-label consumer reads.  Entries
+    are already keyed by flat name, so flattening never merges or drops
+    a series — it only removes the ``name``/``labels`` keys."""
+    return {k: {kk: vv for kk, vv in e.items()
+                if kk not in ("name", "labels")}
+            for k, e in snap.items()}
 
 #: latency buckets (seconds): 100 µs .. 10 s, roughly log-spaced — spans
 #: the sub-ms localhost PS round-trip and the multi-second compile
@@ -39,10 +81,12 @@ COUNT_BUCKETS = (0, 1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 96, 128)
 class Counter:
     """Monotonically-increasing accumulator."""
 
-    __slots__ = ("name", "_value", "_lock")
+    __slots__ = ("name", "base_name", "labels", "_value", "_lock")
 
     def __init__(self, name: str):
         self.name = name
+        self.base_name = name
+        self.labels: Optional[dict] = None
         self._value = 0.0
         self._lock = threading.Lock()
 
@@ -63,10 +107,12 @@ class Counter:
 class Gauge:
     """Last-write-wins level; ``inc``/``dec`` for up-down tracking."""
 
-    __slots__ = ("name", "_value", "_lock")
+    __slots__ = ("name", "base_name", "labels", "_value", "_lock")
 
     def __init__(self, name: str):
         self.name = name
+        self.base_name = name
+        self.labels: Optional[dict] = None
         self._value = 0.0
         self._lock = threading.Lock()
 
@@ -97,12 +143,15 @@ class Histogram:
     add elementwise — the property that lets per-worker staleness
     histograms roll up into one distribution."""
 
-    __slots__ = ("name", "bounds", "counts", "_sum", "_count", "_lock")
+    __slots__ = ("name", "base_name", "labels", "bounds", "counts",
+                 "_sum", "_count", "_lock")
 
     def __init__(self, name: str, buckets: Sequence[Number] = TIME_BUCKETS):
         if list(buckets) != sorted(buckets):
             raise ValueError(f"histogram {name}: buckets must be ascending")
         self.name = name
+        self.base_name = name
+        self.labels: Optional[dict] = None
         self.bounds = tuple(float(b) for b in buckets)
         self.counts = [0] * (len(self.bounds) + 1)  # +1: the +Inf bucket
         self._sum = 0.0
@@ -191,39 +240,60 @@ class Registry:
         self._instruments: Dict[str, object] = {}
         self._lock = threading.Lock()
 
-    def _get(self, name: str, kind: type, **kw):
+    def _get(self, name: str, kind: type,
+             labels: Optional[Mapping[str, object]] = None, **kw):
+        flat = flat_name(name, labels)
         with self._lock:
-            inst = self._instruments.get(name)
+            inst = self._instruments.get(flat)
             if inst is None:
-                inst = self._instruments[name] = kind(name, **kw)
+                inst = self._instruments[flat] = kind(flat, **kw)
+                if labels:
+                    inst.base_name = name
+                    inst.labels = {k: str(labels[k]) for k in sorted(labels)}
             elif not isinstance(inst, kind):
                 raise TypeError(
-                    f"instrument {name!r} already registered as "
+                    f"instrument {flat!r} already registered as "
                     f"{type(inst).__name__}, requested {kind.__name__}")
             return inst
 
-    def counter(self, name: str) -> Counter:
-        return self._get(name, Counter)
+    def counter(self, name: str,
+                labels: Optional[Mapping[str, object]] = None) -> Counter:
+        return self._get(name, Counter, labels=labels)
 
-    def gauge(self, name: str) -> Gauge:
-        return self._get(name, Gauge)
+    def gauge(self, name: str,
+              labels: Optional[Mapping[str, object]] = None) -> Gauge:
+        return self._get(name, Gauge, labels=labels)
 
     def histogram(self, name: str,
-                  buckets: Sequence[Number] = TIME_BUCKETS) -> Histogram:
-        return self._get(name, Histogram, buckets=buckets)
+                  buckets: Sequence[Number] = TIME_BUCKETS, *,
+                  labels: Optional[Mapping[str, object]] = None) -> Histogram:
+        return self._get(name, Histogram, labels=labels, buckets=buckets)
 
-    def get(self, name: str):
-        return self._instruments.get(name)
+    def get(self, name: str,
+            labels: Optional[Mapping[str, object]] = None):
+        return self._instruments.get(flat_name(name, labels))
 
     def names(self) -> list:
         with self._lock:
             return sorted(self._instruments)
 
-    def snapshot(self) -> dict:
-        """{name: instrument snapshot} — plain data, wire/JSON-safe."""
+    def snapshot(self, labeled: bool = False) -> dict:
+        """{flat name: instrument snapshot} — plain data, wire/JSON-safe.
+
+        ``labeled=True`` adds ``name``/``labels`` metadata keys to every
+        entry whose instrument carries labels; keys stay the FLAT names
+        either way, so flattening (``flatten_snapshot``) and merging
+        commute — label-merge-then-flatten == flatten-then-merge."""
         with self._lock:
             insts = dict(self._instruments)
-        return {name: inst.snapshot() for name, inst in sorted(insts.items())}
+        out = {}
+        for name, inst in sorted(insts.items()):
+            e = inst.snapshot()
+            if labeled and inst.labels:
+                e["name"] = inst.base_name
+                e["labels"] = dict(inst.labels)
+            out[name] = e
+        return out
 
     @staticmethod
     def merge_snapshots(*snaps: dict) -> dict:

@@ -253,8 +253,10 @@ class StragglerDetector:
             targets = ewma if updated is None or updated not in ewma \
                 else {updated: ewma[updated]}
             for w, e in targets.items():
-                self.registry.gauge(
-                    f"ps.heartbeat_gap_ewma.worker{w}").set(e)
+                # labeled series (ISSUE 20); flattens to the legacy
+                # ps.heartbeat_gap_ewma.worker<k> name
+                self.registry.gauge("ps.heartbeat_gap_ewma",
+                                    labels={"worker": w}).set(e)
         return set(self._flagged)
 
     def record_link(self, worker_id, rtt_s, downshifts=None) -> None:
@@ -277,7 +279,8 @@ class StragglerDetector:
                 except (TypeError, ValueError):
                     pass
         if self.registry is not None:
-            self.registry.gauge(f"ps.link.rtt_ewma.worker{w}").set(r)
+            self.registry.gauge("ps.link.rtt_ewma",
+                                labels={"worker": w}).set(r)
 
     def commit_weight(self, worker_id) -> float:
         """DynSGD-style down-weighting multiplier for this worker's NEXT

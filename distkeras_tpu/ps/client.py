@@ -664,6 +664,17 @@ class PSClient:
         return self._rpc({"action": "stats", "worker_id": self.worker_id},
                          retry=True)
 
+    def ship_telemetry(self, delta: dict, *, source: str) -> dict:
+        """Push one ``snapshot_delta`` increment frame to the server's
+        telemetry aggregator (ISSUE 20).  Never auto-retries: a frame
+        the server may already have folded would double-count on replay
+        — the shipper keeps unacked increments in its next frame
+        instead."""
+        return self._rpc({"action": "telemetry",
+                          "worker_id": self.worker_id,
+                          "source": str(source), "delta": delta},
+                         retry=False)
+
     def close(self) -> None:
         try:
             # over the negotiated channel: a shm server answers even the

@@ -948,6 +948,14 @@ class FrameServer:
         self._conns: list = []
         self._conn_lock = threading.Lock()
         self._running = threading.Event()
+        #: telemetry plane (ISSUE 20): every front-end accepts pushed
+        #: ``telemetry`` frames into a lazily-created aggregator and
+        #: answers ``alerts`` polls; ``enable_alerts`` attaches a live
+        #: rule engine.  Lazy so a server nobody ships to carries no
+        #: store at all.
+        self.telemetry = None
+        self.alerts = None
+        self._plane_lock = threading.Lock()
         self._g_conns = registry.gauge(f"{self.metric_prefix}.connections")
         self._g_inflight = registry.gauge(f"{self.metric_prefix}.inflight")
         #: transient accept-loop errors survived (ISSUE 9 satellite:
@@ -977,6 +985,66 @@ class FrameServer:
     def _before_close_connections(self) -> None:
         """Between closing the listener and closing live connections —
         where in-flight work drains so replies still flush."""
+
+    # -- telemetry plane (ISSUE 20) -----------------------------------------
+    def enable_telemetry(self, store=None):
+        """Attach (or lazily create) the push-telemetry aggregator.
+        Idempotent; also called implicitly by the first ``telemetry``
+        frame, so shippers need no out-of-band setup handshake."""
+        with self._plane_lock:
+            if self.telemetry is None:
+                if store is None:
+                    from ..obs.timeseries import TimeSeriesStore
+                    store = TimeSeriesStore(registry=self.registry)
+                self.telemetry = store
+            return self.telemetry
+
+    def enable_alerts(self, rules, *, events=None, self_ingest=True,
+                      eval_interval_s=0.25):
+        """Attach a live :class:`~distkeras_tpu.obs.alerts.AlertEngine`
+        over this server's aggregator.  ``self_ingest`` folds the
+        server's OWN registry into the store each evaluation, so a
+        standalone server (no pushing workers yet) is still alertable
+        on its local metrics.  ``rules`` takes parsed
+        :class:`~distkeras_tpu.obs.alerts.AlertRule` objects or the raw
+        OBS_BASELINE ``alerts`` document form (list of dicts / dict
+        with an ``alerts`` key)."""
+        from ..obs.alerts import AlertEngine, AlertRule, parse_rules
+        if not (isinstance(rules, (list, tuple))
+                and all(isinstance(r, AlertRule) for r in rules)):
+            rules = parse_rules(rules)
+        store = self.enable_telemetry()
+        with self._plane_lock:
+            if self.alerts is None:
+                self.alerts = AlertEngine(
+                    store, rules, registry=self.registry, events=events,
+                    source_registry=self.registry if self_ingest else None,
+                    eval_interval_s=eval_interval_s)
+            return self.alerts
+
+    def _handle_plane(self, action, msg: dict):
+        """Generic ``telemetry``/``alerts`` actions every front-end
+        answers (PS, shard, engine, router) — tried before the
+        subclass's unknown-action fallback.  Returns ``None`` for other
+        actions."""
+        if action == "telemetry":
+            store = self.telemetry or self.enable_telemetry()
+            n = store.ingest_delta(str(msg.get("source") or "unknown"),
+                                   msg.get("delta"))
+            if self.alerts is not None:
+                # evaluation rides the ingest path, rate-limited inside
+                # the engine — no dedicated alert thread anywhere
+                self.alerts.evaluate()
+            return {"ok": True, "accepted": n}
+        if action == "alerts":
+            alerts_doc = None
+            if self.alerts is not None:
+                self.alerts.evaluate()
+                alerts_doc = self.alerts.state_doc()
+            return {"ok": True, "alerts": alerts_doc,
+                    "telemetry": self.telemetry.summary()
+                    if self.telemetry is not None else None}
+        return None
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "FrameServer":
@@ -1115,7 +1183,10 @@ class FrameServer:
                                  version=ver, count_as=down)
                         return
                     else:
-                        reply = self.handle_request(action, msg, ver, chan)
+                        reply = self._handle_plane(action, msg)
+                        if reply is None:
+                            reply = self.handle_request(action, msg, ver,
+                                                        chan)
                         if reply is None:
                             reply = {"ok": False,
                                      "error": f"unknown action {action!r}"}

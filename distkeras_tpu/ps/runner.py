@@ -152,7 +152,7 @@ class FleetSupervisor:
                  startup_grace_s: float = 300.0, poll_s: float = 0.05,
                  max_attempts: int = 2, timeout: Optional[float] = None,
                  metrics=None, placement: str = "threads",
-                 shard_watch=None):
+                 shard_watch=None, telemetry_ingest_s: Optional[float] = 1.0):
         self.ps = ps
         self.server = server
         #: sharded-center health probe (ISSUE 10): called once per poll;
@@ -178,6 +178,26 @@ class FleetSupervisor:
         self.zombies: list = []     # evicted-but-alive old incarnations
         self._handles: list = []    # every handle ever spawned (cleanup)
         self._log = get_logger("ps.fleet")
+        #: self-healing latency (ISSUE 20 satellite): eviction -> the
+        #: replacement's FIRST commit landing, per recovery.  The drift
+        #: gate tracks it across runs; a regression here means respawn
+        #: (re-serialize + interpreter start + recompile) got slower.
+        reg = getattr(ps, "registry", None) or getattr(server, "registry",
+                                                       None)
+        # the sharded facade's registry is a read-only merged VIEW with
+        # no instrument constructors — recovery timing needs a real
+        # registry to write into, so sharded fleets skip the histogram
+        self._h_recovery = reg.histogram("ps.recovery_seconds") \
+            if reg is not None and hasattr(reg, "histogram") else None
+        self._evicted_at: dict = {}   # worker_id -> eviction monotonic
+        self._recovering: dict = {}   # worker_id -> (t_evict, start_window)
+        #: in-process telemetry ingest cadence (ISSUE 20): thread
+        #: placement shares ONE process registry across all workers, so
+        #: per-worker shippers would multiply deltas — the supervisor
+        #: folds the process registry into the server's aggregator
+        #: instead, as one "workers" source.  None disables.
+        self.telemetry_ingest_s = telemetry_ingest_s
+        self._last_ingest: Optional[float] = None
 
     # -- spawning -----------------------------------------------------------
     def _spawn_into_live(self, k: int, start_window: int, generation: int,
@@ -250,6 +270,7 @@ class FleetSupervisor:
                           "reached window %d", k, h.attempt, reason, window)
         self._event("evict", k, reason=reason, window=window)
         with self._lock:
+            self._evicted_at[k] = time.monotonic()
             if self.live.get(k) is h:
                 del self.live[k]
             if h.alive():
@@ -282,6 +303,12 @@ class FleetSupervisor:
                           k, used, start, gen)
         self._event("respawn", k, window=start, attempt=used)
         self._spawn_into_live(k, start, gen, used)
+        with self._lock:
+            t0 = self._evicted_at.pop(k, None)
+            if t0 is not None:
+                # recovery window open: closes at the replacement's first
+                # commit past its start window (the _stall_reason signal)
+                self._recovering[k] = (t0, start)
 
     # -- the watch loop -----------------------------------------------------
     def run(self) -> dict:
@@ -318,12 +345,48 @@ class FleetSupervisor:
                     with self._lock:
                         del self.live[k]
                         self.finished.setdefault(k, []).append(h)
+            self._poll_recovery()
+            self._maybe_ingest_telemetry()
             if deadline is not None and time.monotonic() > deadline:
                 raise RuntimeError(
                     f"async fleet timed out after {self.timeout:.0f}s")
             time.sleep(self.poll_s)
+        self._poll_recovery()   # a replacement may finish within one poll
         self._reap_zombies()
         return self._merged_losses()
+
+    def _poll_recovery(self) -> None:
+        """Close any open eviction->first-commit recovery windows."""
+        if not self._recovering or self._h_recovery is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            open_windows = list(self._recovering.items())
+        for k, (t0, start) in open_windows:
+            if self.ps.commits_by_worker.get(k, 0) > start:
+                with self._lock:
+                    self._recovering.pop(k, None)
+                self._h_recovery.observe(now - t0)
+                self._event("recovered", k, seconds=now - t0)
+
+    def _maybe_ingest_telemetry(self) -> None:
+        """Thread placement's push substitute (ISSUE 20): fold the shared
+        process registry into the server's aggregator as one source, at
+        the shipper cadence, so the live fleet series exists without N
+        same-registry shippers double-counting."""
+        if self.telemetry_ingest_s is None or self.placement != "threads" \
+                or not hasattr(self.server, "enable_telemetry"):
+            return
+        now = time.monotonic()
+        if self._last_ingest is not None and \
+                now - self._last_ingest < float(self.telemetry_ingest_s):
+            return
+        self._last_ingest = now
+        from ..obs.registry import default_registry
+        store = self.server.enable_telemetry()
+        store.ingest_total("workers", default_registry().snapshot())
+        if self.server.alerts is not None:
+            self.server.alerts.evaluate()
 
     def _reap_zombies(self) -> None:
         """Give evicted-but-alive incarnations a short grace to wind down
@@ -698,6 +761,10 @@ def _run_process_workers(trainer, ps, server, mode, center, xs, ys,
             # --export-trace see both halves of every cross-process span
             "metrics_jsonl": os.path.join(td,
                                           f"metrics_{k}_{attempt}.jsonl"),
+            # push telemetry (ISSUE 20): each worker PROCESS ships its own
+            # registry deltas to the PS aggregator — the live counterpart
+            # of the post-join JSONL fold above
+            "telemetry_s": getattr(trainer, "telemetry_s", 1.0),
             "attempt": attempt,
         }
 
@@ -723,8 +790,12 @@ def _run_process_workers(trainer, ps, server, mode, center, xs, ys,
             sup.terminate_all()
             # fold every worker process's telemetry into the trainer's
             # sink (failure paths included — the heartbeats are exactly
-            # what the postmortem wants) BEFORE the tempdir vanishes
-            _fold_worker_metrics(trainer, td)
+            # what the postmortem wants) BEFORE the tempdir vanishes.
+            # Optional since ISSUE 20: a fleet on push telemetry already
+            # has the live series — set fold_worker_jsonl=False to skip
+            # the post-join re-read on large fleets
+            if getattr(trainer, "fold_worker_jsonl", True):
+                _fold_worker_metrics(trainer, td)
     return losses
 
 

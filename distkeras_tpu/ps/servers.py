@@ -314,8 +314,10 @@ class DynSGDParameterServer(ParameterServer):
     def _worker_hist(self, w: int):  # dklint: holds=mutex
         h = self._h_by_worker.get(w)
         if h is None:
+            # labeled per-worker series (ISSUE 20); flattens to the
+            # legacy ps.staleness.worker<k> name
             h = self._h_by_worker[w] = self.registry.histogram(
-                f"ps.staleness.worker{w}", COUNT_BUCKETS)
+                "ps.staleness", COUNT_BUCKETS, labels={"worker": w})
         return h
 
     def apply_commit(self, delta, meta):  # dklint: holds=mutex
@@ -473,7 +475,8 @@ class SocketParameterServer(FrameServer):
         w = int(worker_id)
         weight = self.stragglers.commit_weight(w)
         if self._liveness.weight_changed(w, weight):
-            self.ps.registry.gauge(f"ps.commit_weight.worker{w}").set(weight)
+            self.ps.registry.gauge("ps.commit_weight",
+                                   labels={"worker": w}).set(weight)
         return weight
 
     # -- pull state seam (ISSUE 10) -----------------------------------------

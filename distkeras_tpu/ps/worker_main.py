@@ -26,7 +26,11 @@ The spec is a msgpack tree (``utils.serde``):
      stream: heartbeats + ``ps.commit``/``ps.pull`` spans under trace id
      ``w<worker_id>``; the runner folds it back into the trainer's sink
      so ``obsview --export-trace`` links BOTH halves of every wire span,
-     ISSUE 6)}
+     ISSUE 6),
+     "telemetry_s": float|None (push-telemetry cadence — ship registry
+     ``snapshot_delta`` frames to the PS aggregator every that many
+     seconds over the existing connection; default None = off,
+     ISSUE 20)}
 
 Used by ``ps.runner.run_async_training`` when the trainer asks for
 ``async_workers="processes"``; also runnable by hand for manual clusters
@@ -103,7 +107,8 @@ def run_spec(spec_path: str) -> None:
         shm=bool(spec.get("ps_shm", False)),
         pull_overlap=bool(spec.get("pull_overlap", False)),
         profile_memory=bool(spec.get("profile_memory", True)),
-        generation=int(spec.get("gen", 0)), **kw)
+        generation=int(spec.get("gen", 0)),
+        telemetry_s=spec.get("telemetry_s"), **kw)
     if "stream" in spec:
         # disk-streaming partition: this process reads ITS shards straight
         # from the (shared) dataset directory — nothing was staged for it.

@@ -30,6 +30,7 @@ import jax
 import numpy as np
 
 from ..obs import profile as obs_profile
+from ..obs.logging import get_logger
 from ..obs.spans import SpanTracer
 from ..parallel.sync import _inexact, adopt_float_leaves, tmap as _tmap
 from .client import PSClient, WorkerEvicted
@@ -57,7 +58,8 @@ class AsyncWorker(threading.Thread):
                  device=None, start_window: int = 0, metrics=None,
                  comm_codec: str = "none", profile_memory: bool = True,
                  generation: int = 0, comm_down: str = "none",
-                 shm: bool = False, pull_overlap: bool = False):
+                 shm: bool = False, pull_overlap: bool = False,
+                 telemetry_s: Optional[float] = None):
         super().__init__(name=f"worker-{worker_id}", daemon=True)
         self.worker_id = worker_id
         #: commit generation this incarnation runs under (ISSUE 9): the
@@ -131,6 +133,14 @@ class AsyncWorker(threading.Thread):
         #: ``mem.*`` gauges in the process-wide registry + ``live_bytes``
         #: on every heartbeat record (the per-window HBM trail)
         self.profile_memory = bool(profile_memory)
+        #: push-telemetry cadence (ISSUE 20): when set, the worker ships
+        #: ``snapshot_delta`` frames of its process-wide registry to the
+        #: PS every ``telemetry_s`` seconds.  Meant for PROCESS placement
+        #: (one registry per worker process); thread-placement fleets
+        #: share one registry, so the supervisor ingests it in-process
+        #: instead of N workers each shipping the same deltas.
+        self.telemetry_s = telemetry_s
+        self._shipper = None
 
     def set_data(self, xs, ys):
         self.xs, self.ys = xs, ys
@@ -174,9 +184,29 @@ class AsyncWorker(threading.Thread):
             self.tracer.set_trace_id(f"w{self.worker_id}")
             self._last_commit_mono = time.monotonic()
             client = self._make_client()
+            if self.telemetry_s:
+                from ..obs.registry import default_registry
+                from ..obs.timeseries import TelemetryShipper
+                # frames ride the existing PS connection; retry-less, so
+                # a frame the server may have folded never replays
+                self._shipper = TelemetryShipper(
+                    default_registry(),
+                    lambda p: client.ship_telemetry(
+                        p["delta"], source=p["source"]),
+                    source=f"worker{self.worker_id}",
+                    period_s=float(self.telemetry_s))
             try:
                 self._train(client)
             finally:
+                if self._shipper is not None:
+                    # flush the tail increments before the socket closes
+                    # (ship() itself swallows and counts SEND failures;
+                    # this guard keeps teardown alive on anything else)
+                    try:
+                        self._shipper.ship()
+                    except Exception as e:
+                        get_logger("ps.worker").warning(
+                            "final telemetry flush failed: %s", e)
                 client.close()
         except WorkerEvicted:
             # eviction notice, not a failure: the supervisor's replacement
@@ -276,6 +306,10 @@ class AsyncWorker(threading.Thread):
         straggler detector and obsview (ISSUE 5 — no wall-clock-diff
         reconstruction downstream; readers fall back to the pre-PR-5
         ``worker`` key on old streams)."""
+        if self._shipper is not None:
+            # window-boundary hook, BEFORE the metrics-sink guard: push
+            # telemetry is independent of the JSONL heartbeat stream
+            self._shipper.maybe_ship()
         if self.metrics is None:
             return
         _, losses = self.window_losses[-1]

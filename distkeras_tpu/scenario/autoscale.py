@@ -91,8 +91,15 @@ class AutoScaler:
     def __init__(self, router, policy: Optional[AutoscalePolicy] = None,
                  *, target: Optional[SLOTarget] = None,
                  registry: Optional[Registry] = None,
-                 events: Optional[MetricsLogger] = None):
+                 events: Optional[MetricsLogger] = None,
+                 alerts=None):
         self.router = router
+        #: optional :class:`~distkeras_tpu.obs.alerts.AlertEngine`
+        #: (ISSUE 20): when set, each tick evaluates it and prefers its
+        #: burn-rate attainment (computed over the router's PUSH-fed
+        #: aggregator windows) to this scaler's own two-poll delta math —
+        #: one SLO computation shared by alerts and scaling decisions
+        self.alerts = alerts
         self.policy = policy if policy is not None else AutoscalePolicy()
         self.target = target if target is not None else SLOTarget()
         self.registry = registry if registry is not None \
@@ -151,7 +158,10 @@ class AutoScaler:
         reply = self.router._handle_stats()
         stats = reply.get("stats", {}) or {}
         att = None
-        if self._last_stats is not None:
+        if self.alerts is not None:
+            self.alerts.evaluate()
+            att = self.alerts.attainment_signal()
+        if att is None and self._last_stats is not None:
             delta = snapshot_delta(self._last_stats, stats)
             e2e = delta.get(E2E_HIST)
             if e2e and e2e.get("count", 0) >= self.policy.min_samples:

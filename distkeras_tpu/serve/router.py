@@ -659,7 +659,17 @@ class ServeRouter(FrameServer):
                 self._note_poll_failure(be, e)
                 continue
             self._adopt_stats(be, reply)
+            # telemetry plane (ISSUE 20): the poll this router already
+            # runs IS the fleet's engine-stats source — fold each reply
+            # into the aggregator so obsview/alerts read one live series
+            # instead of adding their own N poll loops
+            stats = reply.get("stats")
+            if isinstance(stats, dict):
+                store = self.telemetry or self.enable_telemetry()
+                store.ingest_total(f"engine:{be.addr}", stats)
             self._rollforward(be)
+        if self.alerts is not None:
+            self.alerts.evaluate()
 
     def _poll_loop(self) -> None:
         while not self._poll_stop.wait(float(self.config.stats_interval_s)):

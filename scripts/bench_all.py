@@ -50,13 +50,73 @@ def run_scenario_smoke() -> int:
         emit(f"scenario smoke: unparseable bench row ({e})", err=True)
         return 1
     counts = s.get("counts", {})
+    alerts = s.get("alerts") or {}
     emit(f"| scenario smoke | {counts.get('dispatched', 0)} dispatched "
          f"({counts.get('completed', 0)} ok, "
          f"{counts.get('rejected', 0)} shed, "
          f"{counts.get('timeouts', 0)} timeout) "
          f"| attainment_ok {row.get('attainment_ok')} "
          f"| retraces {row.get('jit_retraces')} "
+         f"| alerts {alerts.get('fired', 'n/a')} "
          f"| {s.get('wall_s', 0):.1f}s |")
+    # ISSUE 20: the smoke runs with the committed alert rules LIVE on
+    # the router — a healthy toy fleet must end the storm quiet.  A
+    # missing alerts block means the wiring regressed (rules no longer
+    # reach the router), which must fail just as loudly as a firing.
+    if alerts.get("fired") != 0 or alerts.get("firing") != 0:
+        emit(f"scenario smoke: alert self-check FAILED — expected zero "
+             f"fired/firing alerts, got {alerts or 'no alerts block'}",
+             err=True)
+        return 1
+    return 0
+
+
+def run_alert_injection() -> int:
+    """In-process alert-engine self-check (ISSUE 20): feed the committed
+    OBS_BASELINE rules a gross injected SLO breach (every e2e sample at
+    4x the bound) and assert EXACTLY the e2e burn-rate rule fires —
+    proof the live plane both fires on real breaches and stays quiet on
+    rules whose metrics carry no evidence."""
+    from distkeras_tpu.obs import Registry
+    from distkeras_tpu.obs.alerts import AlertEngine, parse_rules
+    from distkeras_tpu.obs.drift import load_baseline
+    from distkeras_tpu.obs.timeseries import TimeSeriesStore
+    try:
+        doc = load_baseline(os.path.join(ROOT, "OBS_BASELINE.json"))
+        rules = parse_rules(doc.get("alerts") or [])
+    except (OSError, ValueError) as e:
+        emit(f"alert self-check: unusable OBS_BASELINE alerts ({e})",
+             err=True)
+        return 1
+    e2e = [r for r in rules
+           if r.kind == "burn_rate" and r.metric == "serve.e2e_seconds"]
+    if len(e2e) != 1:
+        emit(f"alert self-check: want exactly one committed e2e burn "
+             f"rule, found {len(e2e)}", err=True)
+        return 1
+    rule = e2e[0]
+    clock = [0.0]
+    store = TimeSeriesStore(clock=lambda: clock[0])
+    engine = AlertEngine(store, rules, eval_interval_s=0.0,
+                         clock=lambda: clock[0])
+    src = Registry()
+    h = src.histogram("serve.e2e_seconds")
+    # breach spread across ticks so BOTH burn windows hold >= min_samples
+    for _ in range(max(3, rule.min_samples)):
+        clock[0] += rule.short_s / max(3, rule.min_samples)
+        h.observe(rule.bound_s * 4)
+        store.ingest_total("inject", src.snapshot())
+        engine.evaluate(force=True)
+    clock[0] += rule.for_s + 0.001  # ride out any for_s hysteresis
+    engine.evaluate(force=True)
+    fired = sorted(r["name"] for r in engine.state_doc()["rules"]
+                   if r["firing"])
+    if fired != [rule.name]:
+        emit(f"alert self-check FAILED: injected 4x-SLO breach should "
+             f"fire exactly [{rule.name}], got {fired}", err=True)
+        return 1
+    emit(f"| alert self-check | injected 4x e2e breach fired exactly "
+         f"[{rule.name}] |")
     return 0
 
 
@@ -122,6 +182,8 @@ if __name__ == "__main__":
         [os.path.join(ROOT, "configs", "bench_all.yaml"), *sys.argv[1:]])
     if rc == 0 and "--job" not in sys.argv[1:]:
         rc = run_scenario_smoke()
+    if rc == 0 and "--job" not in sys.argv[1:]:
+        rc = run_alert_injection()
     if rc == 0 and "--job" not in sys.argv[1:]:
         rc = run_dklint_gate()
     sys.exit(rc)

@@ -1254,6 +1254,183 @@ def run_scenario(target: str) -> int:
     return 0
 
 
+def poll_alerts(host: str, port: int) -> dict:
+    """One ``alerts`` RPC against any FrameServer front-end (PS, shard,
+    engine, router — ISSUE 20): hello handshake, ask, read."""
+    import socket as _socket
+    from distkeras_tpu.ps.networking import (client_handshake, recv_msg,
+                                             send_msg)
+    sock = _socket.create_connection((host, int(port)), timeout=10)
+    try:
+        ver = client_handshake(sock)
+        send_msg(sock, {"action": "alerts"}, version=ver)
+        return recv_msg(sock)
+    finally:
+        sock.close()
+
+
+def _burn_gauge(measure: dict) -> str:
+    """Compact burn-rate gauge cell for one burn_rate rule."""
+    bs, bl = measure.get("burn_short"), measure.get("burn_long")
+    if bs is None or bl is None:
+        return "no data yet"
+    att = measure.get("attainment_short")
+    return (f"burn {_num(bs):.2f}/{_num(bl):.2f} "
+            f"(max {_num(measure.get('max_burn')):.1f})  "
+            f"attain {'n/a' if att is None else f'{_num(att):.3f}'}")
+
+
+def summarize_alerts(alerts, telemetry, source: str) -> str:
+    """Live/engine-state alerts panel over an ``alerts`` RPC reply (or a
+    persisted engine ``state_doc``): per-rule firing state + burn
+    gauges, transition tallies, the ALERT-FLAP warning, and the
+    aggregator's source ages."""
+    lines = [f"== Alerts ({source}) =="]
+    if not alerts:
+        lines.append("no alert engine attached (enable_alerts() was "
+                     "never called on this server)")
+    else:
+        counts = alerts.get("counts", {})
+        lines.append(f"fired {counts.get('fired', 0)}  "
+                     f"resolved {counts.get('resolved', 0)}  "
+                     f"firing now {counts.get('firing', 0)}")
+        lines.append(f"{'rule':<20} {'kind':<10} {'metric':<30} "
+                     f"{'state':<9} {'fired':>5} {'rsvd':>5}")
+        flapping = []
+        for r in alerts.get("rules", []):
+            if r.get("flapping"):
+                flapping.append(r.get("name", "?"))
+            state = "FIRING" if r.get("firing") else "ok"
+            lines.append(
+                f"{r.get('name', '?'):<20} {r.get('kind', '?'):<10} "
+                f"{r.get('metric', '?'):<30} {state:<9} "
+                f"{r.get('fired', 0):>5} {r.get('resolved', 0):>5}"
+                + ("  << ALERT" if r.get("firing") else ""))
+            m = r.get("measure") or {}
+            if r.get("kind") == "burn_rate":
+                lines.append(f"  {_burn_gauge(m)}")
+            elif "value" in m:
+                lines.append(f"  value {_num(m['value']):g} "
+                             f"(max {_num(m.get('max_value')):g})")
+            elif "rate" in m:
+                lines.append(f"  rate {_num(m['rate']):.3f}/s "
+                             f"(max {_num(m.get('max_rate')):g}/s)")
+        if flapping:
+            lines.append(f"ALERT-FLAP: {', '.join(sorted(flapping))} "
+                         f"(rapid fire/resolve churn — widen for_s/"
+                         f"clear_s or fix the thresholds)")
+    store = telemetry if telemetry else (alerts or {}).get("store")
+    if store:
+        lines += ["", f"telemetry: {store.get('series', 0)} series, "
+                      f"{store.get('points', 0)} ring points"]
+        for src, age in sorted((store.get("sources") or {}).items()):
+            lines.append(f"  source {src:<24} last frame "
+                         f"{_num(age):.1f}s ago")
+    return "\n".join(lines)
+
+
+def summarize_alert_records(records: list, source: str) -> str:
+    """JSONL-replay alerts panel: the ``alert`` transition trail a run's
+    events stream recorded, with the same flap math the live engine
+    applies (>= 4 transitions of one rule inside 60s)."""
+    lines = [f"== Alert trail ({source}) =="]
+    if not records:
+        lines.append("no alert records in stream")
+        return "\n".join(lines)
+    t0 = _num(records[0].get("ts"), 0.0)
+    by_rule: dict = {}
+    firing: set = set()
+    for r in records:
+        name = r.get("rule", "?")
+        ts = _num(r.get("ts"), 0.0)
+        by_rule.setdefault(name, []).append(ts)
+        state = str(r.get("state", "?")).upper()
+        if r.get("state") == "firing":
+            firing.add(name)
+        else:
+            firing.discard(name)
+        detail = _burn_gauge(r) if "burn_short" in r else (
+            f"value {_num(r.get('value')):g}" if "value" in r else "")
+        lines.append(f"  t={ts - t0:>8.3f}s  {state:<9} {name:<20} "
+                     f"({r.get('metric', '?')})  {detail}")
+    lines.append("firing at end: "
+                 + (", ".join(sorted(firing)) if firing else "none"))
+    flappers = sorted(
+        name for name, tss in by_rule.items()
+        if any(sum(1 for t in tss if 0 <= t2 - t <= 60.0) >= 4
+               for t2 in tss))
+    if flappers:
+        lines.append(f"ALERT-FLAP: {', '.join(flappers)} (rapid "
+                     f"fire/resolve churn in the recorded trail)")
+    return "\n".join(lines)
+
+
+def summarize_alert_metrics(stats: dict, doc: dict, source: str) -> str:
+    """Snapshot-file alerts panel: the ``obs.alerts.*`` tallies a
+    persisted registry snapshot carries (labeled per-rule counters
+    flatten to ``obs.alerts.{fired,resolved}.rule<name>``), plus the
+    persisted engine state when the bench stored one."""
+    alerts_doc = (doc.get("row") or {}).get("alerts") \
+        if isinstance(doc.get("row"), dict) else None
+    if isinstance(alerts_doc, dict) and alerts_doc.get("rules"):
+        return summarize_alerts(alerts_doc, None, source)
+    lines = [f"== Alerts ({source}) =="]
+    fired = stats.get("obs.alerts.fired", {}).get("value")
+    resolved = stats.get("obs.alerts.resolved", {}).get("value")
+    flaps = stats.get("obs.alerts.flaps", {}).get("value")
+    if fired is None:
+        lines.append("no obs.alerts.* metrics in snapshot (run had no "
+                     "alert engine)")
+        return "\n".join(lines)
+    lines.append(f"fired {fired:g}  resolved {_num(resolved, 0):g}  "
+                 f"flaps {_num(flaps, 0):g}"
+                 + ("  << ALERT-FLAP" if _num(flaps, 0) > 0 else ""))
+    per_rule = {k: v for k, v in stats.items()
+                if k.startswith(("obs.alerts.fired.rule",
+                                 "obs.alerts.resolved.rule"))}
+    for k in sorted(per_rule):
+        lines.append(f"  {k:<44} {per_rule[k].get('value', 0):g}")
+    tel = {k: v.get("value") for k, v in stats.items()
+           if k.startswith("obs.telemetry.") and "value" in v}
+    if tel:
+        lines.append("telemetry: " + "  ".join(
+            f"{k.rsplit('.', 1)[-1]} {v:g}" for k, v in sorted(tel.items())))
+    return "\n".join(lines)
+
+
+def run_alerts(target: str) -> int:
+    """``--alerts`` body: live HOST:PORT (any FrameServer's ``alerts``
+    RPC), a persisted registry-snapshot file, or a JSONL events stream
+    (replays its ``alert`` records)."""
+    host, _, port = target.rpartition(":")
+    if host and port.isdigit():
+        reply = poll_alerts(host, int(port))
+        if not isinstance(reply, dict) or not reply.get("ok", False):
+            emit(f"obsview --alerts: {target} answered "
+                 f"{reply.get('error', reply) if isinstance(reply, dict) else reply!r}",
+                 err=True)
+            return 2
+        emit(summarize_alerts(reply.get("alerts"), reply.get("telemetry"),
+                              f"live {target}"))
+        return 0
+    try:
+        snap = load_snapshot(target)
+    except OSError as e:
+        emit(f"obsview --alerts: cannot read {target}: {e}", err=True)
+        return 2
+    if snap is None:
+        alerts = [r for r in load_records(target)
+                  if r.get("event") == "alert"]
+        emit(summarize_alert_records(alerts, os.path.basename(target)))
+        return 0
+    from distkeras_tpu.obs import Registry
+    regs = list(drift.named_registries(snap).values())
+    stats = regs[0] if len(regs) == 1 else (
+        Registry.merge_snapshots(*regs) if regs else {})
+    emit(summarize_alert_metrics(stats, snap, os.path.basename(target)))
+    return 0
+
+
 def run_diff(base: str, cand: str, thresholds=None) -> int:
     """``--diff`` body: drift-gate two snapshot files.  Exit codes are the
     CI contract — 0 clean, 1 drift, 2 unreadable/invalid input."""
@@ -1326,6 +1503,14 @@ def main(argv=None) -> int:
                          "decode service and renders the autoscaler's "
                          "signal view over the same merged-stats path "
                          "as --serve")
+    ap.add_argument("--alerts", metavar="TARGET",
+                    help="alerts panel (ISSUE 20): HOST:PORT polls any "
+                         "telemetry-plane front-end's alerts RPC (PS, "
+                         "shard, engine, router) and renders the live "
+                         "rule table with burn-rate gauges and the "
+                         "ALERT-FLAP warning; a snapshot file renders "
+                         "its obs.alerts.* tallies; a JSONL file "
+                         "replays the recorded alert transition trail")
     ap.add_argument("--diff", nargs=2, metavar=("BASE", "CAND"),
                     help="compare two registry-snapshot files for "
                          "distribution drift (exit 0 clean / 1 drift / "
@@ -1345,9 +1530,9 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if sum(map(bool, (args.jsonl, args.ps, args.serve, args.continual,
-                      args.scenario, args.diff))) != 1:
+                      args.scenario, args.alerts, args.diff))) != 1:
         ap.error("need exactly one of JSONL, --ps, --serve, --continual, "
-                 "--scenario or --diff")
+                 "--scenario, --alerts or --diff")
     if args.export_trace and not args.jsonl:
         ap.error("--export-trace needs a JSONL metrics file")
 
@@ -1359,6 +1544,9 @@ def main(argv=None) -> int:
 
     if args.scenario:
         return run_scenario(args.scenario)
+
+    if args.alerts:
+        return run_alerts(args.alerts)
 
     if args.ps:
         try:

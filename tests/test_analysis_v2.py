@@ -353,6 +353,66 @@ def test_metric_contract_gated_counter_must_be_precreated(tmp_path):
     assert _metric_findings(root2) == []
 
 
+def test_metric_contract_flags_dead_alert_rule(tmp_path):
+    # an alert rule on a metric nobody creates can never fire — silently
+    root = _mini_repo(
+        tmp_path,
+        {"metrics": {},
+         "alerts": [{"name": "r", "kind": "threshold",
+                     "metric": "pkg.ghost", "max_value": 0}]},
+        """
+        def build(registry):
+            return registry.counter("pkg.live")
+        """)
+    found = _metric_findings(root)
+    assert len(found) == 1
+    assert "dead alert rule 'r'" in found[0].message
+    assert "pkg.ghost" in found[0].message
+    assert found[0].rel == "OBS_BASELINE.json"
+
+
+def test_metric_contract_flags_malformed_alerts_doc(tmp_path):
+    # structural problems surface through the SAME strict parser the
+    # live engine uses — one finding anchored at the alerts block
+    root = _mini_repo(
+        tmp_path,
+        {"metrics": {},
+         "alerts": [{"name": "r", "kind": "threshold",
+                     "metric": "pkg.live", "max_valu": 0}]},
+        """
+        def build(registry):
+            return registry.counter("pkg.live")
+        """)
+    found = _metric_findings(root)
+    assert len(found) == 1
+    assert "malformed alert rules" in found[0].message
+    assert "max_valu" in found[0].message
+
+
+def test_metric_contract_alert_rule_matches_labeled_site(tmp_path):
+    # a labeled creation site registers the glob family; a rule on the
+    # flattened member matches it, and a rule with a label key the site
+    # never uses is a typo finding
+    root = _mini_repo(
+        tmp_path,
+        {"metrics": {},
+         "alerts": [
+             {"name": "ok", "kind": "threshold",
+              "metric": "pkg.lag", "labels": {"worker": 3},
+              "max_value": 5},
+             {"name": "typo", "kind": "threshold",
+              "metric": "pkg.lag", "labels": {"shard": 3},
+              "max_value": 5}]},
+        """
+        def build(registry, i):
+            return registry.gauge("pkg.lag", labels={"worker": i})
+        """)
+    found = _metric_findings(root)
+    assert len(found) == 1
+    assert "alert rule 'typo'" in found[0].message
+    assert "'shard'" in found[0].message and "typo" in found[0].message
+
+
 def test_metric_contract_repo_contract_holds():
     """Acceptance: every OBS_BASELINE.json threshold/ignore pattern
     matches a real creation site, every obsview read is emitted

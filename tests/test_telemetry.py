@@ -1,0 +1,509 @@
+"""Fleet telemetry plane (ISSUE 20): labeled metrics, push-shipped
+time series, and live SLO burn-rate alerting.
+
+Three rungs, each tested at its own seam and then end to end:
+
+* labels — ``flat_name`` back-compat flattening, labeled snapshots, and
+  the merge/flatten commutation property (seeded random);
+* shipping — ``TimeSeriesStore`` ingest (delta + cumulative, hostile
+  input per-entry rejection, ring/series bounds, windowed reads) and
+  ``TelemetryShipper`` delta-base semantics (a failed frame's
+  increments ride the next one);
+* alerting — ``AlertEngine`` threshold + burn-rate hysteresis under a
+  manual clock (fire edge, resolve edge, no-flap, evidence-hold), and
+  the live acceptance: a real 2-engine fleet behind a ``ServeRouter``
+  whose injected latency fault fires the burn-rate alert over the wire
+  and resolves after the fault clears, with zero retraces.
+"""
+
+import math
+import time
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.models import zoo
+from distkeras_tpu.obs import Registry, flat_name, flatten_snapshot
+from distkeras_tpu.obs.alerts import (AlertEngine, AlertRule,
+                                      hist_fraction_le, parse_rules)
+from distkeras_tpu.obs.timeseries import TelemetryShipper, TimeSeriesStore
+
+
+# ---------------------------------------------------------------------------
+# rung 1: labels
+# ---------------------------------------------------------------------------
+
+def test_flat_name_matches_legacy_worker_suffix():
+    assert flat_name("ps.staleness", {"worker": 3}) == "ps.staleness.worker3"
+    assert flat_name("ps.staleness") == "ps.staleness"
+    assert flat_name("ps.staleness", None) == "ps.staleness"
+    # sorted key order, multi-label
+    assert flat_name("m", {"worker": 1, "shard": 2}) == "m.shard2.worker1"
+
+
+def test_flat_name_rejects_hostile_labels():
+    with pytest.raises(ValueError, match="bad label key"):
+        flat_name("m", {"Worker": 1})          # not [a-z]...
+    with pytest.raises(ValueError, match="bad label key"):
+        flat_name("m", {"a.b": 1})             # dots fork segments
+    with pytest.raises(ValueError, match="bad label value"):
+        flat_name("m", {"worker": "a.b"})      # dots in value too
+    with pytest.raises(ValueError, match="bad label value"):
+        flat_name("m", {"worker": "a b"})      # whitespace
+
+
+def test_labeled_instruments_flatten_to_flat_names():
+    reg = Registry()
+    reg.counter("ps.commits", labels={"worker": 0}).inc(3)
+    reg.counter("ps.commits", labels={"worker": 1}).inc(5)
+    reg.gauge("ps.staleness", labels={"worker": 0}).set(2)
+    snap = reg.snapshot()
+    assert snap["ps.commits.worker0"]["value"] == 3
+    assert snap["ps.commits.worker1"]["value"] == 5
+    assert snap["ps.staleness.worker0"]["value"] == 2
+    # plain snapshot carries NO label metadata (back-compat shape)
+    assert "labels" not in snap["ps.commits.worker0"]
+    lab = reg.snapshot(labeled=True)
+    assert lab["ps.commits.worker0"]["name"] == "ps.commits"
+    assert lab["ps.commits.worker0"]["labels"] == {"worker": "0"}
+    # flattening the labeled form recovers the plain form exactly
+    assert flatten_snapshot(lab) == snap
+
+
+def test_labeled_same_instrument_is_shared():
+    reg = Registry()
+    a = reg.counter("x", labels={"worker": 7})
+    b = reg.counter("x", labels={"worker": 7})
+    assert a is b
+    a.inc()
+    assert reg.snapshot()["x.worker7"]["value"] == 1
+
+
+def test_label_merge_then_flatten_commutes_with_flatten_then_merge():
+    """Property (seeded): merging labeled snapshots then flattening is
+    the same plain snapshot as flattening each side first and merging —
+    so mixed fleets (labeled new workers, flat old ones) fold cleanly
+    whichever side of the wire flattens."""
+    rng = np.random.default_rng(20)
+    for _ in range(10):
+        regs = []
+        for _r in range(3):
+            reg = Registry()
+            for _i in range(int(rng.integers(1, 6))):
+                idx = int(rng.integers(0, 3))
+                name = f"m{idx}"
+                labels = {"worker": int(rng.integers(0, 3))} \
+                    if rng.random() < 0.7 else None
+                kind = idx            # kind is a function of the name
+                if kind == 0:
+                    reg.counter(name, labels=labels).inc(
+                        float(rng.integers(1, 10)))
+                elif kind == 1:
+                    reg.gauge(name, labels=labels).set(float(rng.random()))
+                else:
+                    reg.histogram(name, labels=labels).observe(
+                        float(rng.random()))
+            regs.append(reg)
+        labeled = [r.snapshot(labeled=True) for r in regs]
+        flat = [r.snapshot() for r in regs]
+        merged_then_flat = flatten_snapshot(
+            Registry.merge_snapshots(*labeled))
+        flat_then_merged = Registry.merge_snapshots(*flat)
+        assert merged_then_flat == flat_then_merged
+
+
+# ---------------------------------------------------------------------------
+# rung 2: the store
+# ---------------------------------------------------------------------------
+
+def _counter_delta(v):
+    return {"type": "counter", "value": v}
+
+
+def _hist_delta(counts, bounds=(1.0, 2.0), total=None, s=0.0):
+    return {"type": "histogram", "bounds": list(bounds),
+            "counts": list(counts), "sum": s,
+            "count": sum(counts) if total is None else total}
+
+
+def test_store_ingest_delta_folds_and_reads_back():
+    clk = [0.0]
+    store = TimeSeriesStore(clock=lambda: clk[0])
+    assert store.ingest_delta("w0", {"c": _counter_delta(2)}) == 1
+    clk[0] = 1.0
+    store.ingest_delta("w0", {"c": _counter_delta(3)})
+    store.ingest_delta("w1", {"c": _counter_delta(10)})
+    assert store.latest()["c"]["value"] == 15
+    assert store.names() == ["c"]
+    assert set(store.sources()) == {"w0", "w1"}
+    # windowed: only the ts>=cut points fold
+    assert store.window_delta("c", 0.5, now=1.0)["value"] == 13
+    assert store.window_delta("c", 10.0, now=1.0)["value"] == 15
+    assert store.window_delta("c", 0.5, now=100.0) is None
+
+
+def test_store_ingest_total_derives_increments_with_restart_clamp():
+    clk = [0.0]
+    store = TimeSeriesStore(clock=lambda: clk[0])
+    store.ingest_total("ps", {"c": _counter_delta(5)})
+    clk[0] = 1.0
+    store.ingest_total("ps", {"c": _counter_delta(8)})   # +3
+    assert store.latest()["c"]["value"] == 8
+    assert store.window_delta("c", 0.5, now=1.0)["value"] == 3
+    # restart: cumulative fell — the clamp folds the new absolute level,
+    # never a negative increment
+    clk[0] = 2.0
+    store.ingest_total("ps", {"c": _counter_delta(2)})
+    assert store.window_delta("c", 0.5, now=2.0)["value"] == 2
+
+
+def test_store_rejects_hostile_entries_per_entry():
+    reg = Registry()
+    store = TimeSeriesStore(registry=reg)
+    n = store.ingest_delta("evil", {
+        "nan": _counter_delta(float("nan")),
+        "inf": {"type": "gauge", "value": float("inf")},
+        "badh": {"type": "histogram", "bounds": [2.0, 1.0],
+                 "counts": [1, 1, 1], "sum": 1.0, "count": 3},
+        "neg": {"type": "histogram", "bounds": [1.0],
+                "counts": [-1, 1], "sum": 1.0, "count": 0},
+        "shape": {"type": "histogram", "bounds": [1.0], "counts": [1],
+                  "sum": 0.0, "count": 1},
+        "weird": {"type": "nonsense", "value": 1},
+        "notdict": 42,
+        "ok": _counter_delta(1),
+    })
+    assert n == 1                      # only "ok" landed
+    assert store.latest() == {"ok": {"type": "counter", "value": 1}}
+    assert reg.snapshot()["obs.telemetry.rejected"]["value"] == 7
+
+
+def test_store_ring_and_series_bounds():
+    clk = [0.0]
+    store = TimeSeriesStore(max_points=3, max_series=2,
+                            clock=lambda: clk[0])
+    for i in range(5):
+        clk[0] = float(i)
+        store.ingest_delta("w", {"a": _counter_delta(1)})
+    # ring holds the LAST 3 points; totals still cover all 5
+    assert store.window_delta("a", 100.0, now=4.0)["value"] == 3
+    assert store.latest()["a"]["value"] == 5
+    store.ingest_delta("w", {"b": _counter_delta(1)})
+    n = store.ingest_delta("w", {"c": _counter_delta(1)})  # 3rd series
+    assert n == 0 and store.names() == ["a", "b"]
+
+
+def test_store_gauge_keeps_latest_and_histograms_add():
+    clk = [0.0]
+    store = TimeSeriesStore(clock=lambda: clk[0])
+    store.ingest_delta("w", {"g": {"type": "gauge", "value": 1.0},
+                             "h": _hist_delta([1, 0, 0], s=0.5)})
+    clk[0] = 1.0
+    store.ingest_delta("w", {"g": {"type": "gauge", "value": 4.0},
+                             "h": _hist_delta([0, 2, 0], s=3.0)})
+    w = store.window_delta("g", 10.0, now=1.0)
+    assert w["value"] == 4.0           # latest level, not a sum
+    h = store.window_delta("h", 10.0, now=1.0)
+    assert h["counts"] == [1, 2, 0] and h["count"] == 3
+    assert h["sum"] == pytest.approx(3.5)
+
+
+def test_shipper_deltas_and_failed_frames_ride_the_next_one():
+    reg = Registry()
+    c = reg.counter("work")
+    sent, fail = [], [False]
+
+    def send(payload):
+        if fail[0]:
+            raise OSError("injected wire fault")
+        sent.append(payload)
+
+    clk = [0.0]
+    shipper = TelemetryShipper(reg, send, source="w0", period_s=1.0,
+                               clock=lambda: clk[0])
+    c.inc(2)
+    assert shipper.maybe_ship() is True          # first call always ships
+    assert sent[-1]["source"] == "w0"
+    assert sent[-1]["delta"]["work"]["value"] == 2
+    assert shipper.maybe_ship() is False         # inside the period
+    clk[0] = 1.5
+    c.inc(3)
+    fail[0] = True
+    assert shipper.maybe_ship() is False         # swallowed, counted
+    assert reg.snapshot()["obs.telemetry.ship_errors"]["value"] == 1
+    fail[0] = False
+    clk[0] = 3.0
+    c.inc(1)
+    assert shipper.maybe_ship() is True
+    # the failed frame's +3 was NOT lost — it rides with the +1
+    assert sent[-1]["delta"]["work"]["value"] == 4
+    clk[0] = 4.5
+    shipper.maybe_ship()
+    # ...and is never double-counted: no later frame re-ships "work"
+    assert "work" not in sent[-1]["delta"]
+
+
+# ---------------------------------------------------------------------------
+# rung 3: the alert engine (manual clock — deterministic hysteresis)
+# ---------------------------------------------------------------------------
+
+def _engine_with(rules, **kw):
+    clk = [0.0]
+    store = TimeSeriesStore(clock=lambda: clk[0])
+    reg = kw.pop("registry", None)
+    eng = AlertEngine(store, rules, registry=reg, eval_interval_s=0.0,
+                      clock=lambda: clk[0], **kw)
+    return clk, store, eng
+
+
+def test_parse_rules_rejects_malformed():
+    with pytest.raises(ValueError, match="unknown keys"):
+        parse_rules([{"name": "x", "kind": "threshold", "metric": "m",
+                      "max_value": 0, "max_valu": 1}])
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_rules([{"name": "x", "kind": "threshold", "metric": "m",
+                      "max_value": 0}] * 2)
+    with pytest.raises(ValueError, match="needs max_value or max_rate"):
+        parse_rules([{"name": "x", "kind": "threshold", "metric": "m"}])
+    with pytest.raises(ValueError, match="needs bound_s"):
+        parse_rules([{"name": "x", "kind": "burn_rate", "metric": "m"}])
+    with pytest.raises(ValueError, match="unknown kind"):
+        parse_rules([{"name": "x", "kind": "wat", "metric": "m"}])
+    with pytest.raises(ValueError, match="unknown label key"):
+        parse_rules([{"name": "x", "kind": "threshold", "metric": "m",
+                      "max_value": 0, "labels": {"wrker": 1}}])
+    assert parse_rules({"alerts": []}) == []
+    assert parse_rules(None) == []
+
+
+def test_threshold_value_rule_fires_with_for_s_hysteresis():
+    rules = parse_rules([{"name": "r", "kind": "threshold", "metric": "c",
+                          "max_value": 0, "for_s": 1.0}])
+    clk, store, eng = _engine_with(rules)
+    store.ingest_delta("w", {"c": _counter_delta(1)})
+    assert eng.evaluate(force=True) == []        # breach seen, not for_s yet
+    assert eng.firing() == []
+    clk[0] = 1.5
+    evs = eng.evaluate(force=True)
+    assert [e["state"] for e in evs] == ["firing"]
+    assert eng.firing() == ["r"] and eng.counts()["fired"] == 1
+
+
+def test_threshold_rate_rule_fires_and_resolves():
+    rules = parse_rules([{"name": "r", "kind": "threshold", "metric": "c",
+                          "max_rate": 1.0, "window_s": 2.0,
+                          "clear_s": 0.5}])
+    clk, store, eng = _engine_with(rules)
+    store.ingest_delta("w", {"c": _counter_delta(10)})   # 5/s over 2s
+    evs = eng.evaluate(force=True)
+    assert [e["state"] for e in evs] == ["firing"]       # for_s defaults 0
+    # keep a trickle in the window so there IS evidence, rate now low
+    clk[0] = 3.0
+    store.ingest_delta("w", {"c": _counter_delta(1)})    # 0.5/s
+    assert eng.evaluate(force=True) == []                # clean < clear_s
+    clk[0] = 3.6
+    evs = eng.evaluate(force=True)
+    assert [e["state"] for e in evs] == ["resolved"]
+    assert eng.counts() == {"fired": 1, "resolved": 1, "firing": 0}
+
+
+def test_burn_rate_fires_and_resolves_on_clear():
+    rules = parse_rules([{"name": "slo", "kind": "burn_rate",
+                          "metric": "e2e", "bound_s": 1.0,
+                          "attainment": 0.9, "short_s": 2.0, "long_s": 6.0,
+                          "max_burn": 2.0, "min_samples": 4,
+                          "clear_s": 0.5}])
+    clk, store, eng = _engine_with(rules)
+    # 8 samples all ABOVE the bound: burn = (1-0)/(1-0.9) = 10 > 2
+    store.ingest_delta("w", {"e2e": _hist_delta([0, 8], bounds=(1.0,),
+                                                s=16.0)})
+    evs = eng.evaluate(force=True)
+    assert [e["state"] for e in evs] == ["firing"]
+    assert evs[0]["burn_short"] == pytest.approx(10.0)
+    # the fault clears: fresh all-good samples; the breach points age
+    # past BOTH windows
+    clk[0] = 7.0
+    store.ingest_delta("w", {"e2e": _hist_delta([8, 0], bounds=(1.0,),
+                                                s=0.8)})
+    assert eng.evaluate(force=True) == []        # clean, inside clear_s
+    clk[0] = 7.6
+    evs = eng.evaluate(force=True)
+    assert [e["state"] for e in evs] == ["resolved"]
+    assert eng.attainment_signal() == pytest.approx(1.0)
+
+
+def test_burn_rate_holds_state_below_min_samples():
+    rules = parse_rules([{"name": "slo", "kind": "burn_rate",
+                          "metric": "e2e", "bound_s": 1.0,
+                          "min_samples": 8, "short_s": 2.0, "long_s": 4.0}])
+    clk, store, eng = _engine_with(rules)
+    store.ingest_delta("w", {"e2e": _hist_delta([0, 3], bounds=(1.0,),
+                                                s=6.0)})
+    assert eng.evaluate(force=True) == []        # 3 < min_samples: hold
+    assert eng.firing() == []
+    assert eng.state_doc()["rules"][0]["measure"] == {}
+
+
+def test_hostile_nonfinite_series_never_reaches_the_math():
+    rules = parse_rules([{"name": "r", "kind": "threshold", "metric": "c",
+                          "max_value": 0}])
+    clk, store, eng = _engine_with(rules)
+    store.ingest_delta("evil", {"c": _counter_delta(float("nan"))})
+    assert eng.evaluate(force=True) == []        # rejected at ingest: no data
+    assert eng.firing() == []
+
+
+def test_no_flap_under_noisy_breach_inside_hysteresis():
+    """A breach that bounces on/off FASTER than for_s/clear_s must
+    produce zero transitions — the hysteresis contract."""
+    rules = parse_rules([{"name": "r", "kind": "threshold", "metric": "g",
+                          "max_value": 5, "for_s": 1.0, "clear_s": 1.0}])
+    clk, store, eng = _engine_with(rules)
+    transitions = []
+    for i in range(20):                          # 0.1 s noisy square wave
+        clk[0] = i * 0.1
+        level = 10.0 if i % 2 else 0.0
+        store.ingest_delta("w", {"g": {"type": "gauge", "value": level}})
+        transitions += eng.evaluate(force=True)
+    assert transitions == []
+    assert eng.counts() == {"fired": 0, "resolved": 0, "firing": 0}
+
+
+def test_flap_detection_counts_rapid_transitions():
+    rules = parse_rules([{"name": "r", "kind": "threshold", "metric": "g",
+                          "max_value": 5, "for_s": 0.0, "clear_s": 0.0}])
+    reg = Registry()
+    clk, store, eng = _engine_with(rules, registry=reg)
+    evs = []
+    for i in range(6):                           # genuine rapid churn
+        clk[0] = float(i)
+        level = 10.0 if i % 2 == 0 else 0.0
+        store.ingest_delta("w", {"g": {"type": "gauge", "value": level}})
+        evs += eng.evaluate(force=True)
+    assert len(evs) == 6
+    assert any(e["flapping"] for e in evs)
+    snap = reg.snapshot()
+    assert snap["obs.alerts.flaps"]["value"] >= 1
+    # labeled per-rule tallies flatten per the ISSUE 20 rule
+    assert snap["obs.alerts.fired.ruler"]["value"] == 3
+    assert snap["obs.alerts.resolved.ruler"]["value"] == 3
+    assert eng.state_doc()["rules"][0]["flapping"] is True
+
+
+def test_hist_fraction_le_exact_on_bounds():
+    snap = _hist_delta([2, 3, 5], bounds=(1.0, 2.0), s=0.0)
+    assert hist_fraction_le(snap, 1.0) == pytest.approx(0.2)
+    assert hist_fraction_le(snap, 2.0) == pytest.approx(0.5)
+    assert hist_fraction_le(snap, 0.5) == 0.0    # conservative below
+    assert hist_fraction_le(None, 1.0) is None
+    assert hist_fraction_le({"type": "histogram", "count": 0}, 1.0) is None
+
+
+# ---------------------------------------------------------------------------
+# the live acceptance: 2-engine fleet, injected latency fault, wire plane
+# ---------------------------------------------------------------------------
+
+def test_live_alert_end_to_end_two_engine_fleet(tmp_path):
+    """ISSUE 20 acceptance: a real 2-engine fleet behind a ServeRouter
+    with the alert plane live.  An injected latency fault (a worker
+    shipping breaching e2e telemetry over the v2 wire) fires the
+    burn-rate alert within one evaluation window; after the fault
+    clears the alert resolves; nothing retraced; the whole trail is in
+    the events JSONL."""
+    from distkeras_tpu.obs import Registry as _R
+    from distkeras_tpu.ps.client import PSClient
+    from distkeras_tpu.serve import (DecodeEngine, RouterConfig,
+                                     ServeClient, ServeConfig,
+                                     ServeRouter, ServeServer)
+    from distkeras_tpu.utils.metrics import MetricsLogger
+
+    model = zoo.gpt_lm(vocab_size=32, dim=16, num_heads=2, num_blocks=1,
+                       seq_len=32)
+    variables = model.init(0)
+    servers = [
+        ServeServer(DecodeEngine(
+            model, variables,
+            ServeConfig(slots=2, max_queue=8, max_new_tokens=4,
+                        prefill_buckets=(16, 32)),
+            registry=_R()).warmup()).start()
+        for _ in range(2)]
+    events = MetricsLogger(str(tmp_path / "events.jsonl"))
+    router = None
+    try:
+        router = ServeRouter(
+            [("127.0.0.1", s.port) for s in servers],
+            config=RouterConfig(stats_interval_s=30.0)).start()
+        engine = router.enable_alerts(
+            [{"name": "slo-burn", "kind": "burn_rate",
+              "metric": "serve.e2e_seconds", "bound_s": 0.5,
+              "attainment": 0.9, "short_s": 1.0, "long_s": 3.0,
+              "max_burn": 2.0, "min_samples": 4, "clear_s": 0.2}],
+            events=events, eval_interval_s=0.0)
+        # healthy traffic through the front door first
+        client = ServeClient("127.0.0.1", router.port)
+        try:
+            for _ in range(2):
+                assert client.generate([1, 2, 3, 4], 2)["ok"]
+        finally:
+            client.close()
+
+        # the injected fault: a source pushes breaching e2e telemetry
+        # through the generic telemetry frame (the same path worker
+        # shippers use) — every sample 4x over the bound
+        faulty = _R()
+        h = faulty.histogram("serve.e2e_seconds")
+        shipper = PSClient("127.0.0.1", router.port, worker_id=0)
+        try:
+            deadline = time.monotonic() + 10.0
+            fired = []
+            while not fired and time.monotonic() < deadline:
+                for _ in range(4):
+                    h.observe(2.0)
+                reply = shipper.ship_telemetry(
+                    {"serve.e2e_seconds":
+                     faulty.snapshot()["serve.e2e_seconds"]},
+                    source="fault-injector")
+                assert reply["ok"]
+                engine.evaluate(force=True)
+                fired = engine.firing()
+                time.sleep(0.05)
+            assert fired == ["slo-burn"], \
+                f"burn alert never fired (state {engine.state_doc()})"
+
+            # the fault clears: breach points age out of both windows
+            # while good samples keep the evidence alive
+            good = _R()
+            hg = good.histogram("serve.e2e_seconds")
+            deadline = time.monotonic() + 15.0
+            while engine.firing() and time.monotonic() < deadline:
+                for _ in range(4):
+                    hg.observe(0.01)
+                shipper.ship_telemetry(
+                    {"serve.e2e_seconds":
+                     good.snapshot()["serve.e2e_seconds"]},
+                    source="recovered")
+                engine.evaluate(force=True)
+                time.sleep(0.1)
+            assert engine.firing() == [], "alert never resolved after clear"
+        finally:
+            shipper.close()
+
+        counts = engine.counts()
+        assert counts["fired"] == 1 and counts["resolved"] == 1
+        # the alerts RPC serves the same state over the wire
+        stats = ServeClient("127.0.0.1", router.port)
+        try:
+            merged = stats.stats()["stats"]
+        finally:
+            stats.close()
+        assert merged.get("jit.retraces", {}).get("value", 0) == 0
+    finally:
+        if router is not None:
+            router.stop()
+        for s in servers:
+            s.stop()
+        events.close()
+    recs = [r for r in events.records if r["event"] == "alert"]
+    assert [r["state"] for r in recs] == ["firing", "resolved"]
+    assert recs[0]["rule"] == "slo-burn"
+    assert recs[0]["burn_short"] > 2.0
